@@ -1,0 +1,86 @@
+"""pytorch_distributedtraining_tpu — a TPU-native distributed-training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capability stack driven by the
+reference repo `rushi-the-neural-arch/PyTorch-DistributedTraining`
+(`Stoke-DDP.py`, `Fairscale-DDP.py`): the Stoke orchestration facade, the
+Fairscale OSS / ShardedDDP / FSDP sharded-data-parallel family, the
+torch.distributed process-group runtime, the DistributedSampler/DataLoader
+input pipeline, and the SwinIR / ESPCN super-resolution model zoo — rebuilt
+TPU-first:
+
+- collectives are XLA `psum` / `all_gather` / `psum_scatter` / `ppermute`
+  compiled onto ICI/DCN (no NCCL/gloo analogue; ref: Fairscale-DDP.py:27),
+- parallelism engines are sharding *policies* (PartitionSpec rules) over a
+  `jax.sharding.Mesh`, not wrapper classes with autograd hooks
+  (ref: Stoke-DDP.py:248-250, Fairscale-DDP.py:86-89),
+- the training step is one compiled SPMD function (grad-accum, clipping,
+  mixed precision and the optimizer update fused by XLA; ref:
+  Stoke-DDP.py:79-86),
+- models are Flax modules with Pallas kernels for the hot ops.
+
+Public surface (lazily imported):
+    Stoke, StokeOptimizer, configs/enums   — facade twin of stoke (fidelity/stoke)
+    runtime, ops, parallel, data, models   — subpackages
+"""
+
+from importlib import import_module as _import_module
+
+__version__ = "0.1.0"
+
+# Lazy re-exports: keep `import pytorch_distributedtraining_tpu` cheap (no jax
+# backend init, no model imports) while offering the reference's flat surface
+# `from stoke import Stoke, StokeOptimizer, AMPConfig, ...` (Stoke-DDP.py:18-24).
+_LAZY = {
+    # facade
+    "Stoke": ".stoke.facade",
+    "StokeOptimizer": ".stoke.optimizer",
+    # config dataclasses + enums (Stoke-DDP.py:18-24)
+    "AMPConfig": ".stoke.config",
+    "ClipGradNormConfig": ".stoke.config",
+    "ClipGradConfig": ".stoke.config",
+    "DDPConfig": ".stoke.config",
+    "TPUConfig": ".stoke.config",
+    "FairscaleOSSConfig": ".stoke.config",
+    "FairscaleSDDPConfig": ".stoke.config",
+    "FairscaleFSDPConfig": ".stoke.config",
+    "DeepspeedConfig": ".stoke.config",
+    "DeepspeedZeROConfig": ".stoke.config",
+    "DeepspeedAIOConfig": ".stoke.config",
+    "DeepspeedOffloadOptimizerConfig": ".stoke.config",
+    "DeepspeedOffloadParamConfig": ".stoke.config",
+    "DistributedOptions": ".stoke.config",
+    "FP16Options": ".stoke.config",
+    # subpackages
+    "runtime": ".runtime",
+    "ops": ".ops",
+    "parallel": ".parallel",
+    "data": ".data",
+    "models": ".models",
+    "metrics": ".metrics",
+    "losses": ".losses",
+    "optim": ".optim",
+    "precision": ".precision",
+    "checkpoint": ".checkpoint",
+    "observe": ".observe",
+    "utils": ".utils",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        try:
+            mod = _import_module(_LAZY[name], __name__)
+        except ModuleNotFoundError as e:
+            # AttributeError keeps introspection (dir/tab-complete, hasattr)
+            # well-behaved while the surface is still being built out
+            raise AttributeError(
+                f"{__name__}.{name} is not available: {e}"
+            ) from e
+        obj = getattr(mod, name, mod)
+        globals()[name] = obj
+        return obj
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

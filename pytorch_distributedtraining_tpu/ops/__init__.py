@@ -1,0 +1,35 @@
+"""Ops layer: named collectives and Pallas kernels."""
+
+from .collectives import (
+    all_reduce,
+    all_gather,
+    reduce_scatter,
+    broadcast,
+    permute,
+    axis_index,
+    axis_size,
+    barrier,
+    sync_scalar,
+    compressed_broadcast,
+    host_all_gather,
+    host_broadcast,
+    ring_shift,
+    tree_all_reduce,
+)
+
+__all__ = [
+    "all_reduce",
+    "all_gather",
+    "reduce_scatter",
+    "broadcast",
+    "permute",
+    "axis_index",
+    "axis_size",
+    "barrier",
+    "sync_scalar",
+    "compressed_broadcast",
+    "host_all_gather",
+    "host_broadcast",
+    "ring_shift",
+    "tree_all_reduce",
+]

@@ -1,0 +1,39 @@
+"""Runtime layer: process bootstrap, device mesh, launchers.
+
+TPU-native replacement for the reference's L0/L1 layers — gloo/NCCL process
+groups and env:// TCPStore rendezvous (`Fairscale-DDP.py:27,122-123`;
+`torch/distributed/distributed_c10d.py`) — built on `jax.distributed` (PJRT
+coordination service) and `jax.sharding.Mesh` over ICI/DCN axes.
+"""
+
+from .dist import (
+    initialize,
+    shutdown,
+    is_initialized,
+    rank,
+    world_size,
+    process_index,
+    process_count,
+    local_device_count,
+    device_count,
+    find_free_port,
+)
+from .mesh import MeshSpec, make_mesh, best_mesh, mesh_axis_size, current_mesh
+
+__all__ = [
+    "initialize",
+    "shutdown",
+    "is_initialized",
+    "rank",
+    "world_size",
+    "process_index",
+    "process_count",
+    "local_device_count",
+    "device_count",
+    "find_free_port",
+    "MeshSpec",
+    "make_mesh",
+    "best_mesh",
+    "mesh_axis_size",
+    "current_mesh",
+]

@@ -1,0 +1,60 @@
+"""Runtime layer: mesh construction, dist bootstrap, port probe."""
+
+import socket
+
+import jax
+import numpy as np
+import pytest
+
+from pytorch_distributedtraining_tpu import runtime
+from pytorch_distributedtraining_tpu.runtime.mesh import (
+    MeshSpec,
+    batch_spec,
+    make_mesh,
+    mesh_axis_size,
+)
+
+
+def test_find_free_port_is_bindable():
+    port = runtime.find_free_port()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", port))
+
+
+def test_initialize_single_process_noop(monkeypatch):
+    monkeypatch.delenv("MASTER_ADDR", raising=False)
+    monkeypatch.delenv("WORLD_SIZE", raising=False)
+    runtime.initialize()
+    assert runtime.is_initialized()
+    assert runtime.process_count() == 1
+    assert runtime.world_size() == jax.device_count()
+    assert 0 <= runtime.rank() < runtime.world_size()
+
+
+def test_mesh_shapes(devices8):
+    mesh = make_mesh(MeshSpec(dp=8), devices=devices8)
+    assert mesh_axis_size(mesh, "dp") == 8
+    assert mesh_axis_size(mesh, "tp") == 1
+    mesh2 = make_mesh(MeshSpec(dp=4, tp=2), devices=devices8)
+    assert mesh2.shape["dp"] == 4 and mesh2.shape["tp"] == 2
+
+
+def test_mesh_size_mismatch_raises(devices8):
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh(MeshSpec(dp=3), devices=devices8)
+
+
+def test_mesh_kwargs_form(devices8):
+    mesh = make_mesh(dp=2, fsdp=4, devices=devices8)
+    assert mesh.shape["dp"] == 2 and mesh.shape["fsdp"] == 4
+
+
+def test_batch_spec_covers_data_axes(devices8):
+    from jax.sharding import NamedSharding
+
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=4), devices=devices8)
+    spec = batch_spec(mesh)
+    x = np.zeros((16, 3))
+    sharded = jax.device_put(x, NamedSharding(mesh, spec))
+    # batch dim is split over dp*fsdp = 8 devices
+    assert sharded.addressable_shards[0].data.shape == (2, 3)
