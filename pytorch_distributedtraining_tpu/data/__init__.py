@@ -1,0 +1,23 @@
+"""Data layer: datasets, distributed sampler, prefetching loader.
+
+TPU-native twin of the reference's input pipeline — `CustomDataset` +
+`random_split` + `DistributedSampler` + multi-worker `DataLoader`
+(`/root/reference/Stoke-DDP.py:264-298`, `Fairscale-DDP.py:37-64`). Arrays
+are NHWC float32 on host (converted/laid out for the MXU inside the compiled
+step), and the loader feeds `jax.device_put` with a mesh sharding instead of
+pinned-memory H2D copies.
+"""
+
+from .dataset import Dataset, CustomDataset, SyntheticSRDataset, TensorDataset, random_split
+from .sampler import DistributedSampler
+from .loader import DataLoader
+
+__all__ = [
+    "Dataset",
+    "CustomDataset",
+    "SyntheticSRDataset",
+    "TensorDataset",
+    "random_split",
+    "DistributedSampler",
+    "DataLoader",
+]

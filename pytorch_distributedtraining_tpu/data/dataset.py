@@ -1,0 +1,178 @@
+"""Datasets: paired-image SR data, tensor/synthetic datasets, random_split.
+
+Twin of the reference's missing ``old_dataset.CustomDataset(input_path,
+target_path)`` (`/root/reference/Stoke-DDP.py:37,264`;
+`Fairscale-DDP.py:16,37`) and of ``torch.utils.data.random_split``
+(`Stoke-DDP.py:266-269`, 90/10; `Fairscale-DDP.py:40-43`, 99/1).
+
+Layout: images come out **NHWC float32 in [0, 1]** (``img_range=1.``,
+`Stoke-DDP.py:206`) — channels-last is the native TPU conv layout, unlike
+the reference's NCHW.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import numpy as np
+
+_IMG_EXTS = {".png", ".jpg", ".jpeg", ".bmp", ".webp", ".tif", ".tiff"}
+
+
+class Dataset:
+    """Minimal map-style dataset protocol (len + getitem)."""
+
+    def __len__(self) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __getitem__(self, idx: int):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Subset(Dataset):
+    def __init__(self, dataset: Dataset, indices: Sequence[int]):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __len__(self):
+        return len(self.indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+
+def random_split(dataset: Dataset, lengths: Sequence[int], seed: int = 0):
+    """Deterministic twin of ``torch.utils.data.random_split``
+    (`Stoke-DDP.py:266-269`): seeded permutation, contiguous cuts."""
+    if sum(lengths) != len(dataset):
+        raise ValueError(
+            f"lengths {lengths} must sum to dataset size {len(dataset)}"
+        )
+    perm = np.random.default_rng(seed).permutation(len(dataset))
+    out, ofs = [], 0
+    for n in lengths:
+        out.append(Subset(dataset, perm[ofs : ofs + n].tolist()))
+        ofs += n
+    return out
+
+
+class TensorDataset(Dataset):
+    """In-memory arrays, one sample per leading index."""
+
+    def __init__(self, *arrays: np.ndarray):
+        if not arrays or any(len(a) != len(arrays[0]) for a in arrays):
+            raise ValueError("TensorDataset needs >=1 equal-length arrays")
+        self.arrays = arrays
+
+    def __len__(self):
+        return len(self.arrays[0])
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self.arrays)
+
+
+def _load_image(path: str) -> np.ndarray:
+    """Decode to NHWC-sample (H, W, 3) float32 in [0,1].
+
+    Tolerates truncated files like the reference
+    (``ImageFile.LOAD_TRUNCATED_IMAGES = True``, `Stoke-DDP.py:29-30`).
+    """
+    from PIL import Image, ImageFile
+
+    ImageFile.LOAD_TRUNCATED_IMAGES = True
+    with Image.open(path) as im:
+        arr = np.asarray(im.convert("RGB"), dtype=np.float32) / 255.0
+    return arr
+
+
+def _stem(path: str) -> str:
+    """Basename without extension or a trailing LR scale suffix (x2/x3/x4...)."""
+    import re
+
+    stem = os.path.splitext(os.path.basename(path))[0]
+    return re.sub(r"x\d+$", "", stem)
+
+
+def _list_images(root: str) -> list[str]:
+    files = [
+        os.path.join(root, f)
+        for f in sorted(os.listdir(root))
+        if os.path.splitext(f)[1].lower() in _IMG_EXTS
+    ]
+    if not files:
+        raise FileNotFoundError(f"no images under {root}")
+    return files
+
+
+class CustomDataset(Dataset):
+    """Paired LR/HR image-folder dataset (Flickr2K patches in the reference;
+    dirs at `Stoke-DDP.py:169-170`, `Fairscale-DDP.py:32-33`).
+
+    Pairs are matched by sorted filename order; returns
+    ``(input_HWC, target_HWC)`` float32 in [0,1].
+    """
+
+    def __init__(self, input_path: str, target_path: str):
+        self.input_files = _list_images(input_path)
+        self.target_files = _list_images(target_path)
+        if len(self.input_files) != len(self.target_files):
+            raise ValueError(
+                f"input/target counts differ: {len(self.input_files)} vs "
+                f"{len(self.target_files)}"
+            )
+        # guard against silent mis-pairing: stems must match after stripping
+        # scale suffixes (DIV2K-style '0801x2.png' pairs with '0801.png')
+        for a, b in zip(self.input_files, self.target_files):
+            if _stem(a) != _stem(b):
+                raise ValueError(
+                    f"input/target filenames do not pair up: {os.path.basename(a)}"
+                    f" vs {os.path.basename(b)} (stems {_stem(a)!r} != {_stem(b)!r})"
+                )
+
+    def __len__(self):
+        return len(self.input_files)
+
+    def __getitem__(self, idx):
+        return _load_image(self.input_files[idx]), _load_image(self.target_files[idx])
+
+
+class SyntheticSRDataset(Dataset):
+    """Deterministic synthetic LR/HR pairs for tests and benchmarks.
+
+    HR is smooth random imagery; LR is an exact ``scale×scale`` box
+    downsample, so a correct SR model can drive MSE toward zero.
+    """
+
+    def __init__(self, n: int = 64, lr_size: int = 16, scale: int = 2, seed: int = 0):
+        self.n, self.lr_size, self.scale, self.seed = n, lr_size, scale, seed
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, idx):
+        if not 0 <= idx < self.n:
+            raise IndexError(idx)
+        rng = np.random.default_rng(self.seed * 100003 + idx)
+        hs = self.lr_size * self.scale
+        coarse = rng.random((self.lr_size // 2 + 1, self.lr_size // 2 + 1, 3))
+        hr = _bilinear_resize(coarse.astype(np.float32), hs, hs)
+        lr = hr.reshape(
+            self.lr_size, self.scale, self.lr_size, self.scale, 3
+        ).mean(axis=(1, 3))
+        return lr.astype(np.float32), hr.astype(np.float32)
+
+
+def _bilinear_resize(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    h, w, _ = img.shape
+    ys = np.linspace(0, h - 1, out_h)
+    xs = np.linspace(0, w - 1, out_w)
+    y0 = np.clip(ys.astype(int), 0, h - 2)
+    x0 = np.clip(xs.astype(int), 0, w - 2)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    a = img[y0][:, x0]
+    b = img[y0][:, x0 + 1]
+    c = img[y0 + 1][:, x0]
+    d = img[y0 + 1][:, x0 + 1]
+    return a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx + c * wy * (1 - wx) + d * wy * wx

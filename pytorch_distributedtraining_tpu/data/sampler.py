@@ -1,0 +1,81 @@
+"""DistributedSampler twin: deterministic per-process index sharding.
+
+Rebuild of ``torch.utils.data.DistributedSampler`` as wired by the reference
+(`/root/reference/Stoke-DDP.py:272-283`, `Fairscale-DDP.py:45-55`; contract
+at `torch/utils/data/distributed.py:17-100`): seeded permutation, strided
+shard ``rank::num_replicas``, pad-or-drop to equal per-rank length, and
+``set_epoch`` for epoch-fresh shuffles — which the reference never calls
+(bug noted in SURVEY §2.1); our loader calls it automatically.
+
+In the TPU runtime "replica" means *process* (each process feeds all its
+local devices one global-batch slice), so the defaults come from
+``jax.process_count()`` / ``jax.process_index()``, not device counts.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+class DistributedSampler:
+    def __init__(
+        self,
+        dataset,
+        num_replicas: int | None = None,
+        rank: int | None = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if num_replicas is None or rank is None:
+            import jax
+
+            num_replicas = num_replicas if num_replicas is not None else jax.process_count()
+            rank = rank if rank is not None else jax.process_index()
+        if not 0 <= rank < num_replicas:
+            raise ValueError(f"rank {rank} out of range for {num_replicas} replicas")
+        self.dataset = dataset
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+        n = len(dataset)
+        if drop_last and n % num_replicas:
+            self.num_samples = n // num_replicas
+        else:
+            self.num_samples = math.ceil(n / num_replicas)
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the shuffle for a new epoch (torch parity; the loader calls
+        this so the reference's forgot-to-call bug can't recur)."""
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            indices = rng.permutation(n)
+        else:
+            indices = np.arange(n)
+
+        if self.drop_last:
+            indices = indices[: self.total_size]
+        else:  # pad by wrapping (repeatedly, for num_replicas >> n) so every
+            # rank sees exactly num_samples indices
+            pad = self.total_size - n
+            if pad > 0:
+                reps = -(-pad // n)  # ceil
+                indices = np.concatenate([indices] + [indices] * reps)[: self.total_size]
+
+        shard = indices[self.rank :: self.num_replicas]
+        assert len(shard) == self.num_samples
+        return iter(shard.tolist())

@@ -1,0 +1,76 @@
+"""Losses: MSE (Fairscale driver) and the perceptual ``feat_loss``.
+
+- ``mse_loss``: twin of ``nn.MSELoss()`` (`/root/reference/Fairscale-DDP.py:76`).
+- ``l1_loss``: standard SR alternative.
+- ``feat_loss``: twin of the missing ``PyTorchPercept.feat_loss``
+  (`/root/reference/Stoke-DDP.py:35,224`) — a perceptual feature-space loss
+  ``(outputs, targets) -> scalar``. The reference's version rides VGG
+  features; ours uses a fixed (non-trained) random-projection conv feature
+  pyramid — TPU-friendly (pure convs, no torchvision download) with the same
+  role: compare multi-scale feature maps, not pixels. Pixel L1 is mixed in
+  so the loss is also a valid reconstruction objective.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def mse_loss(outputs, targets):
+    return jnp.mean((outputs - targets) ** 2)
+
+
+def l1_loss(outputs, targets):
+    return jnp.mean(jnp.abs(outputs - targets))
+
+
+def _fixed_filters(key, cin: int, cout: int):
+    """Deterministic random 3x3 filters (HWIO), unit-normalized."""
+    w = jax.random.normal(key, (3, 3, cin, cout), dtype=jnp.float32)
+    return w / jnp.sqrt(jnp.sum(w**2, axis=(0, 1, 2), keepdims=True) + 1e-8)
+
+
+def _feature_pyramid(x, filters):
+    feats = []
+    for w in filters:
+        x = jax.lax.conv_general_dilated(
+            x, w, window_strides=(2, 2), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        x = jax.nn.relu(x)
+        feats.append(x)
+    return feats
+
+
+class FeatLoss:
+    """Perceptual loss with fixed random conv features.
+
+    ``FeatLoss()(outputs, targets)`` — callable like the reference's
+    ``feat_loss`` (`Stoke-DDP.py:224`: ``loss=feat_loss``).
+    """
+
+    def __init__(self, depths=(16, 32, 64), pixel_weight: float = 1.0, seed: int = 0):
+        keys = jax.random.split(jax.random.PRNGKey(seed), len(depths))
+        cins = (3,) + tuple(depths[:-1])
+        self.filters = [
+            _fixed_filters(k, cin, cout)
+            for k, cin, cout in zip(keys, cins, depths)
+        ]
+        self.pixel_weight = pixel_weight
+
+    def __call__(self, outputs, targets):
+        fo = _feature_pyramid(outputs, self.filters)
+        ft = _feature_pyramid(targets, self.filters)
+        feat = sum(jnp.mean(jnp.abs(a - b)) for a, b in zip(fo, ft))
+        return feat / len(fo) + self.pixel_weight * l1_loss(outputs, targets)
+
+
+def __getattr__(name):
+    # `feat_loss` is built lazily: constructing its fixed filters touches the
+    # jax backend, which module import must not do
+    if name == "feat_loss":
+        obj = FeatLoss()
+        globals()[name] = obj
+        return obj
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,29 @@
+"""Image-quality metrics: MAE + PSNR.
+
+Twin of the reference's missing ``metrics.py`` module
+(`/root/reference/Stoke-DDP.py:38,120-121`; `Fairscale-DDP.py:17`): the
+validation loop computes ``metrics.psnr(outputs, targets)`` and
+``metrics.mae(outputs, targets)`` on [0,1]-range images
+(``img_range=1.``, `Stoke-DDP.py:206`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def mae(outputs, targets):
+    """Mean absolute error over all pixels/channels."""
+    return jnp.mean(jnp.abs(jnp.asarray(outputs) - jnp.asarray(targets)))
+
+
+def mse(outputs, targets):
+    return jnp.mean((jnp.asarray(outputs) - jnp.asarray(targets)) ** 2)
+
+
+def psnr(outputs, targets, data_range: float = 1.0):
+    """Peak signal-to-noise ratio in dB (data_range=1. per the reference's
+    img_range)."""
+    err = mse(outputs, targets)
+    err = jnp.maximum(err, jnp.finfo(jnp.float32).tiny)  # inf-guard
+    return 10.0 * jnp.log10(data_range**2 / err)

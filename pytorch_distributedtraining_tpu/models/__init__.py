@@ -1,0 +1,49 @@
+"""Model zoo: SR models from the reference plus the BASELINE ladder.
+
+Reference models (both from missing local modules, SURVEY §2.4):
+  - ``Net`` — ESPCN-style sub-pixel conv SR net
+    (`/root/reference/Fairscale-DDP.py:13,74`)
+  - ``SwinIR`` — lightweight shifted-window-attention SR transformer
+    (`/root/reference/Stoke-DDP.py:33,206-208`)
+
+BASELINE ladder (BASELINE.json): ResNet-18/50, GPT-2 125M, ViT-B/16.
+
+All models are Flax linen modules in NHWC (images) / [B, T, D] (sequences) —
+the layouts XLA:TPU tiles best — with bf16-friendly parameterization.
+Imports are lazy so pulling one model doesn't build the whole zoo.
+"""
+
+from importlib import import_module as _import_module
+
+_LAZY = {
+    "Net": ".sr_espcn",
+    "pixel_shuffle": ".sr_espcn",
+    "SwinIR": ".swinir",
+    "ResNet": ".resnet",
+    "ResNet18": ".resnet",
+    "ResNet50": ".resnet",
+    "GPT2": ".gpt2",
+    "GPT2Config": ".gpt2",
+    "ViT": ".vit",
+    "ViTB16": ".vit",
+}
+
+# only names whose modules exist on disk — grows as the zoo ships; _LAZY may
+# lead it (unshipped names raise AttributeError instead of breaking import *)
+__all__ = ["Net", "pixel_shuffle"]
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        try:
+            mod = _import_module(_LAZY[name], __name__)
+        except ModuleNotFoundError as e:
+            raise AttributeError(f"{__name__}.{name} is not available: {e}") from e
+        obj = getattr(mod, name)
+        globals()[name] = obj
+        return obj
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

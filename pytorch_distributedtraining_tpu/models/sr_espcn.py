@@ -1,0 +1,45 @@
+"""``Net``: ESPCN-style sub-pixel convolution super-resolution model.
+
+Functional equivalent of the reference's missing ``models/sr_4k_2x.Net(
+upscale_factor=2)`` (`/root/reference/Fairscale-DDP.py:13,74`; commented alt
+`Stoke-DDP.py:32`) — the classic ESPCN layout (Shi et al. 2016): feature
+convs then one ``r^2·C``-channel conv whose output is pixel-shuffled to the
+upscaled image. NHWC; pixel shuffle is a reshape/transpose XLA fuses into
+the producing conv.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def pixel_shuffle(x: jnp.ndarray, r: int) -> jnp.ndarray:
+    """[B, H, W, C*r^2] -> [B, H*r, W*r, C] (depth-to-space, NHWC)."""
+    b, h, w, crr = x.shape
+    c = crr // (r * r)
+    x = x.reshape(b, h, w, r, r, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # B, H, r, W, r, C
+    return x.reshape(b, h * r, w * r, c)
+
+
+class Net(nn.Module):
+    """ESPCN: conv5x5(64) → conv3x3(32) → conv3x3(C·r²) → pixel shuffle."""
+
+    upscale_factor: int = 2
+    channels: int = 3
+    features: tuple = (64, 32)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        r = self.upscale_factor
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.features[0], (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.features[1], (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(
+            self.channels * r * r, (3, 3), padding="SAME", dtype=self.dtype
+        )(x)
+        return pixel_shuffle(x, r).astype(jnp.float32)
