@@ -76,7 +76,14 @@ def __getattr__(name):
             raise AttributeError(
                 f"{__name__}.{name} is not available: {e}"
             ) from e
-        obj = getattr(mod, name, mod)
+        # subpackage entries (".runtime" for name "runtime") resolve to the
+        # module itself; class entries must exist in their module — a
+        # missing class is a bug we surface at import, not via a module leak
+        target = _LAZY[name]
+        if target.rsplit(".", 1)[-1] == name:
+            obj = mod
+        else:
+            obj = getattr(mod, name)
         globals()[name] = obj
         return obj
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

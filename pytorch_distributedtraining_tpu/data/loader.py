@@ -27,10 +27,16 @@ from .sampler import DistributedSampler
 
 
 def default_collate(samples):
-    """Stack a list of samples; tuples/lists are collated per-field."""
+    """Stack a list of samples; tuples/lists/namedtuples collate per-field."""
     first = samples[0]
+    if isinstance(first, tuple) and hasattr(first, "_fields"):  # namedtuple
+        return type(first)(
+            *(default_collate([s[i] for s in samples]) for i in range(len(first)))
+        )
     if isinstance(first, (tuple, list)):
-        return type(first)(default_collate([s[i] for s in samples]) for i in range(len(first)))
+        return type(first)(
+            default_collate([s[i] for s in samples]) for i in range(len(first))
+        )
     if isinstance(first, dict):
         return {k: default_collate([s[k] for s in samples]) for k in first}
     return np.stack([np.asarray(s) for s in samples])

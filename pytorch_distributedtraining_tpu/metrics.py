@@ -18,7 +18,9 @@ def mae(outputs, targets):
 
 
 def mse(outputs, targets):
-    return jnp.mean((jnp.asarray(outputs) - jnp.asarray(targets)) ** 2)
+    from .losses import mse_loss  # single source of truth for the formula
+
+    return mse_loss(jnp.asarray(outputs), jnp.asarray(targets))
 
 
 def psnr(outputs, targets, data_range: float = 1.0):

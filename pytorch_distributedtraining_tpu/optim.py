@@ -1,0 +1,175 @@
+"""Optimizers and LR schedules: AdamW, OneCycleLR, ReduceLROnPlateau.
+
+Twin of the reference's optimizer surface — ``AdamW(lr=1e-4, betas=(0.9,
+0.999), eps=1e-8, weight_decay=1e-5)`` built from a ``StokeOptimizer`` dict
+(`/root/reference/Stoke-DDP.py:226-235`) or passed to OSS
+(`Fairscale-DDP.py:78-86`) — plus the two schedulers the Stoke driver steps
+(`Stoke-DDP.py:300-306`: ``OneCycleLR`` per-batch, ``ReduceLROnPlateau`` on
+val loss; impls `torch/optim/lr_scheduler.py:1584,2285`).
+
+TPU-native design: schedules are **pure functions of the step counter**
+evaluated *inside* the compiled step (no host round-trip per batch — the
+reference pays a Python call per ``scheduler.step()``). The one genuinely
+data-dependent schedule, ReduceLROnPlateau, runs on host between epochs and
+feeds a scalar ``lr_factor`` into the step — one small transfer per epoch,
+not per batch.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import optax
+
+
+# -- optimizers --------------------------------------------------------------
+
+
+def adamw(
+    lr: float | optax.Schedule = 1e-3,
+    betas: tuple = (0.9, 0.999),
+    eps: float = 1e-8,
+    weight_decay: float = 0.01,
+    clip_grad_norm: float | None = None,
+) -> optax.GradientTransformation:
+    """AdamW with torch-parity argument names.
+
+    ``clip_grad_norm`` fuses global-norm clipping into the chain (twin of
+    ``ClipGradNormConfig(clip=0.1)``, `Stoke-DDP.py:253,164` — torch clips
+    before the step; here it's one XLA-fused chain).
+    """
+    chain = []
+    if clip_grad_norm is not None:
+        chain.append(optax.clip_by_global_norm(clip_grad_norm))
+    chain.append(
+        optax.adamw(
+            learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
+            weight_decay=weight_decay,
+        )
+    )
+    return optax.chain(*chain)
+
+
+def sgd(
+    lr: float | optax.Schedule = 1e-2,
+    momentum: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+    clip_grad_norm: float | None = None,
+) -> optax.GradientTransformation:
+    chain = []
+    if clip_grad_norm is not None:
+        chain.append(optax.clip_by_global_norm(clip_grad_norm))
+    if weight_decay:
+        chain.append(optax.add_decayed_weights(weight_decay))
+    chain.append(optax.sgd(lr, momentum=momentum or None, nesterov=nesterov))
+    return optax.chain(*chain)
+
+
+OPTIMIZERS = {"adamw": adamw, "sgd": sgd}
+
+
+# -- schedules (pure functions of step) --------------------------------------
+
+
+def onecycle(
+    max_lr: float,
+    total_steps: int,
+    pct_start: float = 0.3,
+    div_factor: float = 25.0,
+    final_div_factor: float = 1e4,
+) -> optax.Schedule:
+    """OneCycleLR twin (cosine annealing strategy, torch defaults;
+    `torch/optim/lr_scheduler.py:1584`): warm up from ``max_lr/div_factor``
+    to ``max_lr`` over ``pct_start`` of training, then anneal to
+    ``max_lr/final_div_factor``."""
+    initial = max_lr / div_factor
+    final = initial / final_div_factor
+    warm = max(1, int(total_steps * pct_start))
+
+    def schedule(step):
+        step = jnp.minimum(step, total_steps)
+        up = 0.5 * (1 + jnp.cos(math.pi * (1 - step / warm)))  # 0 -> 1
+        lr_up = initial + (max_lr - initial) * up
+        t = jnp.clip((step - warm) / max(1, total_steps - warm), 0.0, 1.0)
+        down = 0.5 * (1 + jnp.cos(math.pi * t))  # 1 -> 0
+        lr_down = final + (max_lr - final) * down
+        return jnp.where(step < warm, lr_up, lr_down)
+
+    return schedule
+
+
+def cosine_with_warmup(
+    max_lr: float, total_steps: int, warmup_steps: int = 0, final_lr: float = 0.0
+) -> optax.Schedule:
+    def schedule(step):
+        warm = jnp.clip(step / max(1, warmup_steps), 0.0, 1.0)
+        t = jnp.clip(
+            (step - warmup_steps) / max(1, total_steps - warmup_steps), 0.0, 1.0
+        )
+        cos = final_lr + (max_lr - final_lr) * 0.5 * (1 + jnp.cos(math.pi * t))
+        return jnp.where(step < warmup_steps, max_lr * warm, cos)
+
+    return schedule
+
+
+@dataclass
+class ReduceLROnPlateau:
+    """Host-side plateau scheduler (twin of
+    `torch/optim/lr_scheduler.py:2285`; wired at `Stoke-DDP.py:303-306`).
+
+    Call :meth:`step` with the validation metric each epoch; multiply the
+    returned ``factor`` into the compiled step's ``lr_factor`` argument.
+    """
+
+    mode: str = "min"
+    factor: float = 0.1
+    patience: int = 10
+    threshold: float = 1e-4
+    cooldown: int = 0
+    min_factor: float = 0.0  # lower bound on the cumulative factor
+
+    current: float = field(default=1.0, init=False)
+    _best: float = field(default=None, init=False)  # type: ignore[assignment]
+    _bad: int = field(default=0, init=False)
+    _cool: int = field(default=0, init=False)
+
+    def _is_better(self, metric: float) -> bool:
+        if self._best is None:
+            return True
+        if self.mode == "min":
+            return metric < self._best * (1 - self.threshold)
+        return metric > self._best * (1 + self.threshold)
+
+    def step(self, metric: float) -> float:
+        metric = float(metric)
+        if self._is_better(metric):
+            self._best = metric
+            self._bad = 0
+        elif self._cool > 0:
+            self._cool -= 1
+        else:
+            self._bad += 1
+            if self._bad > self.patience:
+                self.current = max(self.current * self.factor, self.min_factor)
+                self._bad = 0
+                self._cool = self.cooldown
+        return self.current
+
+    @property
+    def factor_value(self) -> float:
+        return self.current
+
+    def state_dict(self) -> dict:
+        return {
+            "current": self.current, "best": self._best,
+            "bad": self._bad, "cool": self._cool,
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        self.current = d["current"]
+        self._best = d["best"]
+        self._bad = d["bad"]
+        self._cool = d["cool"]

@@ -1,0 +1,45 @@
+"""Parallelism engines: DDP and the ZeRO family as sharding policies.
+
+The reference's engines are wrapper classes with autograd hooks — DDP's C++
+Reducer (`torch/nn/parallel/distributed.py:1298`), Fairscale's OSS /
+ShardedDDP / FSDP (`/root/reference/Fairscale-DDP.py:86-89`,
+`Stoke-DDP.py:248-250`). TPU-native, an engine is a **sharding policy**: a
+rule assigning a PartitionSpec to every leaf of the train state, plus an
+optional in-step constraint on gradients. XLA's SPMD partitioner then
+materializes exactly the collectives each engine is defined by:
+
+- DDP        → params+state replicated → one grad all-reduce
+- ZeRO-1/OSS → optimizer state sharded → grad all-reduce, sharded update,
+               param all-gather (cf. the cross-replica weight-update
+               sharding paper, PAPERS.md)
+- ZeRO-2/ShardedDDP → + grads constrained sharded → reduce-scatter instead
+               of all-reduce
+- ZeRO-3/FSDP → params sharded too → per-use all-gather, grad
+               reduce-scatter (cf. SimpleFSDP, PAPERS.md)
+
+No bucket loops, no hooks, no wrapper forward: one compiled step.
+"""
+
+from .policy import DDP, ZeRO1, ZeRO2, ZeRO3, OSS, ShardedDDP, FSDP, Policy, policy_from_flags
+from .spec import leaf_spec, tree_specs, shard_axis
+from .state import TrainState, create_train_state
+from .step import TrainStep, EvalStep
+
+__all__ = [
+    "DDP",
+    "ZeRO1",
+    "ZeRO2",
+    "ZeRO3",
+    "OSS",
+    "ShardedDDP",
+    "FSDP",
+    "Policy",
+    "policy_from_flags",
+    "leaf_spec",
+    "tree_specs",
+    "shard_axis",
+    "TrainState",
+    "create_train_state",
+    "TrainStep",
+    "EvalStep",
+]

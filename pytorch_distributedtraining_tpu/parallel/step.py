@@ -1,0 +1,208 @@
+"""The compiled train step: fwd → bwd → clip → update, one XLA program.
+
+This is where the reference's eager hot loop (`/root/reference/
+Stoke-DDP.py:70-86`: forward, loss, ``backward`` with grad-accum division,
+hook-fired collectives, ``step`` with unscale→clip→sharded update→param
+broadcast — three separate device/network crossings) becomes a single SPMD
+function. XLA fuses the collectives into the compute schedule; grad
+accumulation is a `lax.scan` over microbatches inside the step (no host
+round-trips, hard part (b) of SURVEY §7); the fp16 scale/unscale/skip dance
+is branchless arithmetic in the same program.
+
+Contract for ``loss_fn``::
+
+    loss_fn(params, batch, rng, model_state) -> (loss, aux_dict)
+
+``aux_dict`` may carry a ``"model_state"`` entry (updated mutable
+collections, e.g. sync-BN stats) which replaces ``state.model_state``;
+other entries are reported as metrics (averaged over microbatches).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding
+
+from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
+from ..runtime.mesh import batch_spec
+from .policy import Policy
+from .spec import constrain
+from .state import TrainState
+
+
+def _split_microbatches(batch, n: int):
+    """[B, ...] -> [n, B/n, ...] on every leaf."""
+
+    def split(x):
+        b = x.shape[0]
+        if b % n:
+            raise ValueError(f"batch {b} not divisible by grad_accum_steps {n}")
+        return x.reshape(n, b // n, *x.shape[1:])
+
+    return jax.tree.map(split, batch)
+
+
+class TrainStep:
+    """Assembles and jits the policy-sharded train step.
+
+    The eager-feeling facade (`stoke/facade.py`) replays this one compiled
+    function; drivers may also call it directly (the fast path).
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable,
+        tx: optax.GradientTransformation,
+        mesh: Mesh,
+        policy: Policy | None = None,
+        *,
+        grad_accum_steps: int = 1,
+        precision: PrecisionPolicy | None = None,
+        loss_scaler: DynamicLossScaler | None = None,
+        state_shardings: TrainState | None = None,
+        extra_metrics: bool = True,
+        donate: bool = True,
+    ):
+        self.loss_fn = loss_fn
+        self.tx = tx
+        self.mesh = mesh
+        self.policy = policy or Policy()
+        self.grad_accum_steps = int(grad_accum_steps)
+        self.precision = precision or PrecisionPolicy()
+        self.loss_scaler = loss_scaler
+        self.extra_metrics = extra_metrics
+
+        data_sharding = NamedSharding(mesh, batch_spec(mesh))
+        # pytree-prefix semantics: one sharding covers every batch leaf
+        self._jitted = jax.jit(
+            self._step,
+            in_shardings=(state_shardings, data_sharding, None),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    # -- the traced function ------------------------------------------------
+
+    def _grads_one(self, params, model_state, batch, rng, scaler_state):
+        """Value-and-grad on one microbatch (precision + loss scaling)."""
+
+        def lfn(p):
+            pc = self.precision.cast_to_compute(p)
+            loss, aux = self.loss_fn(pc, batch, rng, model_state)
+            scaled = (
+                loss * scaler_state.scale.astype(loss.dtype)
+                if scaler_state is not None
+                else loss
+            )
+            return scaled, (loss, aux)
+
+        (_, (loss, aux)), grads = jax.value_and_grad(lfn, has_aux=True)(params)
+        return loss, aux, grads
+
+    def _step(self, state: TrainState, batch, lr_factor):
+        rng = jax.random.fold_in(state.rng, state.step)
+
+        if self.grad_accum_steps > 1:
+            micro = _split_microbatches(batch, self.grad_accum_steps)
+            zero = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+
+            def body(acc, mb_i):
+                mb, i = mb_i
+                loss, aux, grads = self._grads_one(
+                    state.params, state.model_state, mb,
+                    jax.random.fold_in(rng, i), state.scaler
+                )
+                acc = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), acc, grads
+                )
+                return acc, (loss, aux)
+
+            gsum, (losses, auxs) = jax.lax.scan(
+                body, zero, (micro, jnp.arange(self.grad_accum_steps))
+            )
+            # mean over microbatches (the ref divides in backward, :79,251)
+            grads = jax.tree.map(lambda g: g / self.grad_accum_steps, gsum)
+            loss = jnp.mean(losses)
+            aux = {
+                k: (
+                    jax.tree.map(lambda x: x[-1], v)  # state: keep last
+                    if k == "model_state"
+                    else jax.tree.map(lambda x: jnp.mean(x, axis=0), v)
+                )
+                for k, v in auxs.items()
+            }
+        else:
+            loss, aux, grads = self._grads_one(
+                state.params, state.model_state, batch, rng, state.scaler
+            )
+
+        # fp16: unscale to f32 before clip/update (torch unscale_ parity)
+        new_scaler = None
+        finite = jnp.bool_(True)
+        if self.loss_scaler is not None and state.scaler is not None:
+            grads = self.loss_scaler.unscale_grads(grads, state.scaler)
+            finite = DynamicLossScaler.grads_finite(grads)
+            new_scaler = self.loss_scaler.update(state.scaler, finite)
+        else:
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+
+        # ZeRO-2/3: force reduce-scatter layout on grads
+        gspecs = self.policy.grads_specs(state.params, self.mesh)
+        if gspecs is not None:
+            grads = constrain(grads, gspecs, self.mesh)
+
+        updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+        updates = jax.tree.map(lambda u: u * lr_factor, updates)  # plateau
+        new_params = optax.apply_updates(state.params, updates)
+
+        if self.loss_scaler is not None:
+            # skip the whole update on overflow (GradScaler semantics)
+            new_params = jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new_params, state.params
+            )
+            new_opt = jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new_opt, state.opt_state
+            )
+
+        new_model_state = aux.get("model_state", state.model_state)
+        metrics = {"loss": loss.astype(jnp.float32)}
+        if self.extra_metrics:
+            metrics["grad_norm"] = optax.global_norm(grads)
+            if new_scaler is not None:
+                metrics["loss_scale"] = new_scaler.scale
+        for k, v in aux.items():
+            if k != "model_state":
+                metrics[k] = v
+
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt,
+            model_state=new_model_state,
+            scaler=new_scaler if new_scaler is not None else state.scaler,
+        )
+        return new_state, metrics
+
+    def __call__(self, state: TrainState, batch, lr_factor: float = 1.0):
+        return self._jitted(state, batch, jnp.float32(lr_factor))
+
+
+class EvalStep:
+    """Compiled forward+metrics step (validation loop,
+    `Stoke-DDP.py:101-128`).
+
+    ``eval_fn(params, batch, model_state) -> dict`` of metrics.
+    """
+
+    def __init__(self, eval_fn: Callable, mesh: Mesh):
+        self.eval_fn = eval_fn
+        self._jitted = jax.jit(eval_fn)
+
+    def __call__(self, state: TrainState, batch):
+        return self._jitted(state.params, batch, state.model_state)

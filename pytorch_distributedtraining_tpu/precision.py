@@ -1,0 +1,134 @@
+"""Mixed precision: bf16 compute policy + fp16 dynamic loss scaler.
+
+TPU-native precision story: **bf16 compute, f32 params/optimizer state, no
+loss scaling needed** (bf16 shares f32's exponent range). The fp16
+GradScaler path exists for API parity with the reference's
+``AMPConfig(init_scale=2.**14)`` (`/root/reference/Stoke-DDP.py:182-184`;
+impl `torch/amp/grad_scaler.py:53`) and for the rare fp16 deployment; it is
+a pure pytree so the whole scale/unscale/skip-on-overflow dance stays inside
+the compiled step (torch round-trips to host for ``scaler.update()``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "f32": jnp.float32,
+    "bf16": jnp.bfloat16,
+    "fp16": jnp.float16,
+}
+
+
+def _resolve(dtype):
+    if isinstance(dtype, str):
+        return _DTYPES[dtype]
+    return dtype
+
+
+@dataclass(frozen=True)
+class Policy:
+    """jmp-style three-dtype policy.
+
+    ``param_dtype`` — storage; ``compute_dtype`` — matmul/conv inputs (bf16
+    feeds the MXU at full rate); ``output_dtype`` — loss/outputs.
+    """
+
+    param_dtype: object = jnp.float32
+    compute_dtype: object = jnp.float32
+    output_dtype: object = jnp.float32
+
+    @staticmethod
+    def from_name(name: str | None) -> "Policy":
+        if name in (None, "fp32", "float32", "none"):
+            return Policy()
+        if name in ("bf16", "bfloat16"):
+            return Policy(compute_dtype=jnp.bfloat16)
+        if name in ("fp16", "float16", "amp"):
+            return Policy(compute_dtype=jnp.float16)
+        raise ValueError(f"unknown precision policy {name!r}")
+
+    def cast_to_compute(self, tree):
+        c = _resolve(self.compute_dtype)
+        return jax.tree.map(
+            lambda x: x.astype(c) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+    def cast_to_param(self, tree):
+        p = _resolve(self.param_dtype)
+        return jax.tree.map(
+            lambda x: x.astype(p) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+    def cast_to_output(self, tree):
+        o = _resolve(self.output_dtype)
+        return jax.tree.map(
+            lambda x: x.astype(o) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+
+class ScalerState(struct.PyTreeNode):
+    """Loss-scale state — lives inside the train state, updated in-step."""
+
+    scale: jnp.ndarray  # f32 scalar
+    growth_count: jnp.ndarray  # i32 scalar
+
+    @classmethod
+    def create(cls, init_scale: float = 2.0**14) -> "ScalerState":
+        return cls(
+            scale=jnp.float32(init_scale), growth_count=jnp.int32(0)
+        )
+
+
+@dataclass(frozen=True)
+class DynamicLossScaler:
+    """GradScaler twin (`torch/amp/grad_scaler.py:53` semantics): scale the
+    loss, unscale grads, skip the update on inf/nan, halve on overflow, grow
+    2× after ``growth_interval`` clean steps. All branchless jnp.where — one
+    compiled step, no host sync."""
+
+    init_scale: float = 2.0**14  # AMPConfig parity (Stoke-DDP.py:184)
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+
+    def init(self) -> ScalerState:
+        return ScalerState.create(self.init_scale)
+
+    def scale_loss(self, loss, state: ScalerState):
+        return loss * state.scale.astype(loss.dtype)
+
+    def unscale_grads(self, grads, state: ScalerState):
+        inv = 1.0 / state.scale
+        return jax.tree.map(lambda g: (g.astype(jnp.float32) * inv), grads)
+
+    @staticmethod
+    def grads_finite(grads) -> jnp.ndarray:
+        leaves = jax.tree.leaves(grads)
+        finite = jnp.bool_(True)
+        for g in leaves:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        return finite
+
+    def update(self, state: ScalerState, finite) -> ScalerState:
+        grew = state.growth_count + 1 >= self.growth_interval
+        new_scale = jnp.where(
+            finite,
+            jnp.where(grew, state.scale * self.growth_factor, state.scale),
+            state.scale * self.backoff_factor,
+        )
+        new_count = jnp.where(
+            finite, jnp.where(grew, 0, state.growth_count + 1), 0
+        ).astype(jnp.int32)
+        return ScalerState(scale=new_scale, growth_count=new_count)

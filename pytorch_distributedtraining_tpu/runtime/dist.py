@@ -43,8 +43,8 @@ def find_free_port() -> int:
     single-host rendezvous.
     """
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-        s.bind(("127.0.0.1", 0))
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
@@ -74,7 +74,18 @@ def initialize(
     if _INITIALIZED:
         return
 
-    jax_native_rendezvous = "COORDINATOR_ADDRESS" in os.environ
+    # markers that jax's own rendezvous/auto-detection should drive instead
+    # of the torch-style MASTER_* fallbacks: explicit coordinator, TPU-pod
+    # metadata, or megascale env
+    jax_native_rendezvous = any(
+        k in os.environ
+        for k in (
+            "COORDINATOR_ADDRESS",
+            "TPU_WORKER_HOSTNAMES",
+            "MEGASCALE_COORDINATOR_ADDRESS",
+            "CLOUD_TPU_TASK_ID",
+        )
+    )
     if coordinator_address is None and not jax_native_rendezvous:
         addr = os.environ.get("MASTER_ADDR")
         port = os.environ.get("MASTER_PORT")
