@@ -75,16 +75,13 @@ def initialize(
         return
 
     # markers that jax's own rendezvous/auto-detection should drive instead
-    # of the torch-style MASTER_* fallbacks: explicit coordinator, TPU-pod
-    # metadata, or megascale env
-    jax_native_rendezvous = any(
-        k in os.environ
-        for k in (
-            "COORDINATOR_ADDRESS",
-            "TPU_WORKER_HOSTNAMES",
-            "MEGASCALE_COORDINATOR_ADDRESS",
-            "CLOUD_TPU_TASK_ID",
-        )
+    # of the torch-style MASTER_* fallbacks: explicit coordinator, multi-
+    # worker TPU-pod metadata, or megascale env (single-worker
+    # TPU_WORKER_HOSTNAMES like "localhost" is NOT a pod)
+    jax_native_rendezvous = (
+        "COORDINATOR_ADDRESS" in os.environ
+        or "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
+        or len(os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")) > 1
     )
     if coordinator_address is None and not jax_native_rendezvous:
         addr = os.environ.get("MASTER_ADDR")
@@ -106,12 +103,24 @@ def initialize(
         _INITIALIZED = True
         return
 
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    except ValueError:
+        if not jax_native_rendezvous:
+            raise
+        # auto-detection markers present but incomplete (e.g. single-worker
+        # dev box): degrade to single-process rather than refuse to start
+        logger.warning(
+            "jax.distributed auto-detection failed; continuing single-process",
+            exc_info=True,
+        )
+        _INITIALIZED = True
+        return
     _INITIALIZED = True
     atexit.register(shutdown)
     logger.info(
