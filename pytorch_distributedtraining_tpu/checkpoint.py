@@ -1,0 +1,157 @@
+"""Checkpointing: full-train-state save/restore with torch-parity loading.
+
+The reference saves per-epoch via ``stoke_model.save(path, name)`` →
+``(path, tag)`` (`/root/reference/Stoke-DDP.py:137-147,334`) and loads
+pretrained dicts optionally nested under a ``'params'`` key with
+``strict=True`` (`Stoke-DDP.py:209-213`). It never persists optimizer /
+scheduler / RNG state (SURVEY §5); this module does: the whole TrainState
+plus scheduler states round-trips.
+
+Format: one ``.npz`` per checkpoint. Named pytrees (params, model_state)
+use readable ``params/Conv_0/kernel`` keys — loadable by external tools and
+strict-matchable; positional structures (optax opt_state) use stable
+flatten-order keys and restore into a structure template. Sharded arrays
+are consolidated to host on save (process 0 writes in multi-host runs) and
+re-placed by the caller's shardings on restore.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+import jax
+
+
+def _key_name(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "name"):
+        return str(k.name)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)
+
+
+def tree_to_flat_dict(tree, prefix: str = "", sep: str = "/") -> dict:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = sep.join(_key_name(k) for k in path)
+        flat[f"{prefix}{sep}{key}" if prefix else key] = leaf
+    return flat
+
+
+def flat_dict_to_tree(flat: dict, sep: str = "/") -> dict:
+    """Rebuild a nested dict from ``a/b/c`` keys."""
+    tree: dict = {}
+    for key, value in flat.items():
+        parts = key.split(sep)
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return tree
+
+
+def save_checkpoint(
+    path: str,
+    name: str,
+    named_trees: dict[str, Any],
+    positional_trees: dict[str, Any] | None = None,
+    metadata: dict | None = None,
+) -> tuple[str, str]:
+    """Write one consolidated checkpoint; returns ``(full_path, tag)``.
+
+    ``named_trees`` (e.g. ``{"params": ..., "model_state": ...}``) are saved
+    under readable keys; ``positional_trees`` (opt_state etc.) under
+    flatten-order indices.
+    """
+    os.makedirs(path, exist_ok=True)
+    arrays: dict[str, np.ndarray] = {}
+    for root, tree in named_trees.items():
+        for k, v in tree_to_flat_dict(tree, prefix=root).items():
+            arrays[k] = np.asarray(jax.device_get(v))
+    for root, tree in (positional_trees or {}).items():
+        leaves = jax.tree.leaves(tree)
+        width = len(str(max(len(leaves) - 1, 0)))
+        for i, v in enumerate(leaves):
+            arrays[f"{root}/{i:0{width}d}"] = np.asarray(jax.device_get(v))
+    arrays["__metadata__"] = np.frombuffer(
+        json.dumps(metadata or {}).encode(), dtype=np.uint8
+    )
+
+    tag = f"{name}.npz"
+    full = os.path.join(path, tag)
+    if jax.process_index() == 0:
+        with open(full, "wb") as f:
+            np.savez(f, **arrays)
+    return full, tag
+
+
+def load_checkpoint(path: str) -> tuple[dict, dict]:
+    """Read back ``(flat_arrays, metadata)``."""
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files if k != "__metadata__"}
+        meta = (
+            json.loads(bytes(z["__metadata__"]).decode())
+            if "__metadata__" in z.files
+            else {}
+        )
+    return flat, meta
+
+
+def extract_tree(flat: dict, root: str) -> dict:
+    sub = {
+        k[len(root) + 1 :]: v for k, v in flat.items() if k.startswith(root + "/")
+    }
+    return flat_dict_to_tree(sub)
+
+
+def restore_positional(flat: dict, root: str, template):
+    """Restore a positional tree (opt_state) into ``template``'s structure."""
+    sub = sorted(
+        ((k, v) for k, v in flat.items() if k.startswith(root + "/")),
+        key=lambda kv: kv[0],
+    )
+    leaves_t, treedef = jax.tree.flatten(template)
+    if len(sub) != len(leaves_t):
+        raise ValueError(
+            f"checkpoint {root!r} has {len(sub)} leaves, template needs "
+            f"{len(leaves_t)} — optimizer structure changed?"
+        )
+    return jax.tree.unflatten(treedef, [v for _, v in sub])
+
+
+def load_params_dict(
+    source: dict, template: dict, strict: bool = True, param_key: str = "params"
+):
+    """Torch ``load_state_dict`` parity (`Stoke-DDP.py:209-213`): accept a
+    dict optionally nested under ``param_key``; with ``strict`` raise on
+    missing/unexpected keys; shapes must match."""
+    src = source[param_key] if param_key in source else source
+    flat_src = tree_to_flat_dict(src) if not _is_flat(src) else src
+    flat_tpl = tree_to_flat_dict(template)
+    missing = sorted(set(flat_tpl) - set(flat_src))
+    unexpected = sorted(set(flat_src) - set(flat_tpl))
+    if strict and (missing or unexpected):
+        raise ValueError(
+            f"strict load failed — missing: {missing[:5]}"
+            f"{'...' if len(missing) > 5 else ''}, unexpected: {unexpected[:5]}"
+            f"{'...' if len(unexpected) > 5 else ''}"
+        )
+    out = dict(flat_tpl)
+    for k in flat_tpl:
+        if k in flat_src:
+            if tuple(np.shape(flat_src[k])) != tuple(np.shape(flat_tpl[k])):
+                raise ValueError(
+                    f"shape mismatch for {k}: checkpoint "
+                    f"{np.shape(flat_src[k])} vs model {np.shape(flat_tpl[k])}"
+                )
+            out[k] = flat_src[k]
+    return flat_dict_to_tree(out)
+
+
+def _is_flat(d: dict) -> bool:
+    return all(not isinstance(v, dict) for v in d.values())

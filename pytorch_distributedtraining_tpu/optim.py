@@ -18,7 +18,6 @@ not per batch.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
 
 import jax.numpy as jnp
 import optax
@@ -115,26 +114,111 @@ def cosine_with_warmup(
     return schedule
 
 
-@dataclass
-class ReduceLROnPlateau:
-    """Host-side plateau scheduler (twin of
-    `torch/optim/lr_scheduler.py:2285`; wired at `Stoke-DDP.py:303-306`).
+class OptimizerHandle:
+    """What ``stoke_model.optimizer`` returns: a mutable lr cell.
 
-    Call :meth:`step` with the validation metric each epoch; multiply the
-    returned ``factor`` into the compiled step's ``lr_factor`` argument.
+    Torch schedulers mutate ``optimizer.param_groups[i]['lr']``; the TPU
+    facade reads ``handle.lr`` on host each step and feeds it into the
+    compiled update as a scalar argument — schedulers stay torch-shaped
+    (`Stoke-DDP.py:300-306`) with zero retracing.
     """
 
-    mode: str = "min"
-    factor: float = 0.1
-    patience: int = 10
-    threshold: float = 1e-4
-    cooldown: int = 0
-    min_factor: float = 0.0  # lower bound on the cumulative factor
+    def __init__(self, base_lr: float):
+        self.lr = float(base_lr)
+        self.initial_lr = float(base_lr)
 
-    current: float = field(default=1.0, init=False)
-    _best: float = field(default=None, init=False)  # type: ignore[assignment]
-    _bad: int = field(default=0, init=False)
-    _cool: int = field(default=0, init=False)
+    def __repr__(self):
+        return f"OptimizerHandle(lr={self.lr})"
+
+
+class OneCycleLR:
+    """Torch-call-parity wrapper (`Stoke-DDP.py:300`): per-batch ``.step()``
+    writes the schedule into the optimizer handle."""
+
+    def __init__(
+        self,
+        optimizer: OptimizerHandle,
+        max_lr: float,
+        total_steps: int | None = None,
+        epochs: int | None = None,
+        steps_per_epoch: int | None = None,
+        pct_start: float = 0.3,
+        div_factor: float = 25.0,
+        final_div_factor: float = 1e4,
+    ):
+        if total_steps is None:
+            if epochs is None or steps_per_epoch is None:
+                raise ValueError("need total_steps or epochs+steps_per_epoch")
+            total_steps = epochs * steps_per_epoch
+        self.optimizer = optimizer
+        # pure-python closed form: .step() runs per batch on the host
+        # critical path, so no jnp dispatch / device sync here
+        self._max_lr = max_lr
+        self._initial = max_lr / div_factor
+        self._final = self._initial / final_div_factor
+        self._total = total_steps
+        self._warm = max(1, int(total_steps * pct_start))
+        self._t = 0
+        optimizer.lr = self._lr_at(0)
+
+    def _lr_at(self, step: int) -> float:
+        step = min(step, self._total)
+        if step < self._warm:
+            up = 0.5 * (1 + math.cos(math.pi * (1 - step / self._warm)))
+            return self._initial + (self._max_lr - self._initial) * up
+        t = min(max((step - self._warm) / max(1, self._total - self._warm), 0.0), 1.0)
+        down = 0.5 * (1 + math.cos(math.pi * t))
+        return self._final + (self._max_lr - self._final) * down
+
+    def step(self) -> float:
+        self._t += 1
+        self.optimizer.lr = self._lr_at(self._t)
+        return self.optimizer.lr
+
+    def state_dict(self) -> dict:
+        return {"t": self._t}
+
+    def load_state_dict(self, d: dict) -> None:
+        self._t = int(d["t"])
+        self.optimizer.lr = self._lr_at(self._t)
+
+
+class ReduceLROnPlateau:
+    """Plateau scheduler, host-side (twin of
+    `torch/optim/lr_scheduler.py:2285`; wired at `Stoke-DDP.py:301-306`).
+
+    Two composition modes:
+    - torch parity: pass an :class:`OptimizerHandle` — on trigger the
+      handle's lr is multiplied by ``factor`` (floored at ``min_lr``);
+    - factor mode (no handle): :meth:`step` returns a cumulative factor to
+      feed the compiled step's ``lr_factor`` argument.
+    """
+
+    def __init__(
+        self,
+        optimizer: OptimizerHandle | None = None,
+        mode: str = "min",
+        factor: float = 0.1,
+        patience: int = 10,
+        threshold: float = 1e-4,
+        cooldown: int = 0,
+        min_lr: float = 0.0,
+        min_factor: float = 0.0,
+        verbose: bool = False,
+    ):
+        self.optimizer = optimizer
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.min_factor = min_factor
+        self.verbose = verbose
+        self.current = 1.0
+        self._best: float | None = None
+        self._bad = 0
+        self._cool = 0
 
     def _is_better(self, metric: float) -> bool:
         if self._best is None:
@@ -154,6 +238,12 @@ class ReduceLROnPlateau:
             self._bad += 1
             if self._bad > self.patience:
                 self.current = max(self.current * self.factor, self.min_factor)
+                if self.optimizer is not None:
+                    self.optimizer.lr = max(
+                        self.optimizer.lr * self.factor, self.min_lr
+                    )
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr -> {self.optimizer.lr:.3e}")
                 self._bad = 0
                 self._cool = self.cooldown
         return self.current
@@ -166,6 +256,9 @@ class ReduceLROnPlateau:
         return {
             "current": self.current, "best": self._best,
             "bad": self._bad, "cool": self._cool,
+            # handle mode mutates the lr directly — persist it so resume
+            # into a fresh OptimizerHandle keeps prior cuts
+            "lr": None if self.optimizer is None else self.optimizer.lr,
         }
 
     def load_state_dict(self, d: dict) -> None:
@@ -173,3 +266,5 @@ class ReduceLROnPlateau:
         self._best = d["best"]
         self._bad = d["bad"]
         self._cool = d["cool"]
+        if self.optimizer is not None and d.get("lr") is not None:
+            self.optimizer.lr = d["lr"]

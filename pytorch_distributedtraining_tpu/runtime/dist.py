@@ -74,6 +74,7 @@ def initialize(
     if _INITIALIZED:
         return
 
+    explicit_coordinator = coordinator_address is not None
     # markers that jax's own rendezvous/auto-detection should drive instead
     # of the torch-style MASTER_* fallbacks: explicit coordinator, multi-
     # worker TPU-pod metadata, or megascale env (single-worker
@@ -93,9 +94,13 @@ def initialize(
     if process_id is None and "RANK" in os.environ:
         process_id = int(os.environ["RANK"])
 
+    # multi-process needs an explicit world size (WORLD_SIZE>=2), an
+    # explicitly passed coordinator_address argument, or jax's own
+    # auto-detection; MASTER_* env alone (e.g. set for parity by a driver
+    # running single-process) must not trigger a rendezvous wait
     single_process = (
         (num_processes in (None, 1))
-        and coordinator_address is None
+        and not explicit_coordinator
         and not jax_native_rendezvous
     )
     if single_process:

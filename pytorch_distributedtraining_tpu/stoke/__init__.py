@@ -1,0 +1,54 @@
+"""Stoke facade twin: the reference's top-level orchestration API, TPU-native.
+
+Mirrors the import surface the reference uses (`/root/reference/
+Stoke-DDP.py:18-26`)::
+
+    from pytorch_distributedtraining_tpu.stoke import (
+        Stoke, StokeOptimizer, AMPConfig, ClipGradNormConfig, DDPConfig,
+        DistributedOptions, FairscaleOSSConfig, FP16Options,
+        DeepspeedConfig, DeepspeedZeROConfig,
+    )
+
+plus the TPU-era additions BASELINE.json calls for: ``DistributedOptions.tpu``
+and ``FP16Options.bf16``, and a ``TPUConfig`` for mesh/policy control.
+"""
+
+from .config import (
+    AMPConfig,
+    ClipGradConfig,
+    ClipGradNormConfig,
+    DDPConfig,
+    DeepspeedAIOConfig,
+    DeepspeedConfig,
+    DeepspeedOffloadOptimizerConfig,
+    DeepspeedOffloadParamConfig,
+    DeepspeedZeROConfig,
+    DistributedOptions,
+    FairscaleFSDPConfig,
+    FairscaleOSSConfig,
+    FairscaleSDDPConfig,
+    FP16Options,
+    TPUConfig,
+)
+from .facade import Stoke
+from .optimizer import StokeOptimizer
+
+__all__ = [
+    "Stoke",
+    "StokeOptimizer",
+    "AMPConfig",
+    "ClipGradConfig",
+    "ClipGradNormConfig",
+    "DDPConfig",
+    "TPUConfig",
+    "DeepspeedConfig",
+    "DeepspeedZeROConfig",
+    "DeepspeedAIOConfig",
+    "DeepspeedOffloadOptimizerConfig",
+    "DeepspeedOffloadParamConfig",
+    "DistributedOptions",
+    "FairscaleOSSConfig",
+    "FairscaleSDDPConfig",
+    "FairscaleFSDPConfig",
+    "FP16Options",
+]

@@ -1,0 +1,154 @@
+"""Typed config dataclasses + option enums — the Stoke config surface.
+
+Twin of stoke's (fidelity/stoke) declarative configuration as the reference
+exercises it (`/root/reference/Stoke-DDP.py:18-24,182-199,247-253`), with
+TPU members added (``DistributedOptions.tpu``, ``FP16Options.bf16``,
+``TPUConfig``) per BASELINE.json's north star.
+
+Deepspeed* configs are accepted for API parity; their ZeRO stages map onto
+the same sharding policies (``stage`` 1/2/3 → ZeRO1/2/3) and the
+CUDA-specific knobs (AIO, NVMe offload) are recorded but inert on TPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+
+class DistributedOptions(Enum):
+    ddp = "ddp"
+    deepspeed = "deepspeed"
+    horovod = "horovod"
+    tpu = "tpu"  # TPU-era addition (BASELINE.json north star)
+
+
+class FP16Options(Enum):
+    amp = "amp"
+    apex_O1 = "apex_O1"
+    apex_O2 = "apex_O2"
+    deepspeed = "deepspeed"
+    bf16 = "bf16"  # TPU-era addition: native mixed precision, no scaler
+
+
+@dataclass
+class AMPConfig:
+    """GradScaler knobs (`Stoke-DDP.py:182-184`; torch/amp/grad_scaler.py:53)."""
+
+    init_scale: float = 2.0**16
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    enabled: bool = True
+
+
+@dataclass
+class ClipGradNormConfig:
+    """Global-norm clip (`Stoke-DDP.py:253`). Only L2 (norm_type=2) is
+    supported — XLA's fused global-norm path; other norms raise."""
+
+    max_norm: float
+    norm_type: float = 2.0
+
+    def __post_init__(self):
+        if self.norm_type != 2.0:
+            raise ValueError("only norm_type=2.0 is supported on the TPU path")
+
+
+@dataclass
+class ClipGradConfig:
+    """Clip-by-value twin (stoke parity)."""
+
+    clip: float
+
+
+@dataclass
+class DDPConfig:
+    """DDP knobs (`Stoke-DDP.py:190-193`). ``local_rank`` is accepted for
+    CLI parity but ignored — device placement comes from the PJRT runtime.
+    ``convert_to_sync_batch_norm`` turns on cross-replica batch-stat psum in
+    models that carry BN (twin of torch convert_sync_batchnorm,
+    `torch/nn/modules/batchnorm.py:890`)."""
+
+    local_rank: int | None = None
+    convert_to_sync_batch_norm: bool = False
+    find_unused_parameters: bool = False  # parity no-op: SPMD has no hooks
+    backend: str | None = None  # parity no-op: transport is ICI/DCN
+
+
+@dataclass
+class TPUConfig:
+    """TPU-native knobs (new): mesh axes and policy tuning."""
+
+    dp: int | None = None  # data-parallel width; None = all devices
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    remat: bool = False  # activation rematerialization in the train step
+    donate_state: bool = True
+
+
+@dataclass
+class FairscaleOSSConfig:
+    """OSS knobs (`Stoke-DDP.py:197-199`): ``broadcast_fp16`` compresses the
+    post-step param fan-out; on TPU the analogue is casting the all-gather
+    payload to bf16/fp16 (ops.compressed_broadcast)."""
+
+    broadcast_fp16: bool = False
+
+
+@dataclass
+class FairscaleSDDPConfig:
+    reduce_buffer_size: int = 0  # parity no-op: XLA fuses reductions
+    auto_refresh_trainable: bool = True  # parity no-op
+
+
+@dataclass
+class FairscaleFSDPConfig:
+    reshard_after_forward: bool = True  # parity no-op: XLA schedules gathers
+    flatten_parameters: bool = False  # parity no-op: per-leaf sharding
+    cpu_offload: bool = False  # recorded; host offload not yet wired
+
+
+@dataclass
+class DeepspeedZeROConfig:
+    """ZeRO stage selector (`Stoke-DDP.py:18` import surface). Stage maps to
+    the same sharding policies as the Fairscale flags."""
+
+    stage: int = 0
+    contiguous_gradients: bool = True
+    overlap_comm: bool = True
+    allgather_bucket_size: int = 5e8  # parity no-op
+    reduce_bucket_size: int = 5e8  # parity no-op
+
+
+@dataclass
+class DeepspeedAIOConfig:
+    block_size: int = 1048576
+    queue_depth: int = 8
+    single_submit: bool = False
+    overlap_events: bool = True
+    thread_count: int = 1
+
+
+@dataclass
+class DeepspeedOffloadOptimizerConfig:
+    device: str = "cpu"
+    pin_memory: bool = False
+
+
+@dataclass
+class DeepspeedOffloadParamConfig:
+    device: str = "cpu"
+    pin_memory: bool = False
+
+
+@dataclass
+class DeepspeedConfig:
+    zero_optimization: DeepspeedZeROConfig | None = None
+    aio: DeepspeedAIOConfig | None = None
+    offload_optimizer: DeepspeedOffloadOptimizerConfig | None = None
+    offload_param: DeepspeedOffloadParamConfig | None = None
+    gradient_clipping: float | None = None
+    fp16_enabled: bool = False
+    bf16_enabled: bool = False

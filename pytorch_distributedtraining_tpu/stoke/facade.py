@@ -1,0 +1,634 @@
+"""The Stoke facade: one object owning model, optimizer, loss, precision,
+distribution, grad-accum/clip, data loading, checkpointing and rank I/O.
+
+Twin of stoke's ``Stoke`` class exactly as the reference drives it
+(`/root/reference/Stoke-DDP.py:240-254` construction; runtime surface
+`.model :73`, `.loss :74`, `.backward :79`, `.step :82`, `.model_access
+:68,104`, `.optimizer :300-301`, `.DataLoader :286-298`, `.save :142-145`,
+`.world_size/.rank :274-275`, `.print_on_devices :67,130`, `.print_ema_loss
+:76`, `.detach_and_sync_loss :86`).
+
+TPU-native architecture (hard part (d) of SURVEY §7): the eager-feeling
+``.model → .loss → .backward → .step`` sequence is backed by three compiled
+programs — forward, loss+grad, apply — so user code keeps the reference's
+loop shape while every FLOP runs under jit with the policy's shardings. The
+fused path (:meth:`fused_step`) collapses all three into the single
+TrainStep program for peak throughput; both paths share state bit-for-bit.
+
+Grad accumulation follows Stoke semantics: ``.backward`` scales by
+``1/grad_accum_steps`` and accumulates; ``.step`` fires the optimizer every
+``grad_accum_steps``-th call (`Stoke-DDP.py:251` with the update at `:82`).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import checkpoint as ckpt
+from .. import optim as optim_mod
+from ..data import DataLoader as _DataLoader
+from ..ops import sync_scalar
+from ..parallel import TrainStep, create_train_state, policy_from_flags
+from ..parallel.spec import constrain
+from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
+from ..runtime import dist as _dist
+from ..runtime.mesh import MeshSpec, batch_spec, make_mesh
+from .config import (
+    AMPConfig,
+    ClipGradNormConfig,
+    DDPConfig,
+    DeepspeedConfig,
+    DistributedOptions,
+    FairscaleOSSConfig,
+    FP16Options,
+    TPUConfig,
+)
+from .optimizer import StokeOptimizer
+
+
+class _ModelAccess:
+    """``stoke_model.model_access`` twin: `.train()`/`.eval()` mode switch
+    (`Stoke-DDP.py:68,104`) plus passthrough to the underlying module."""
+
+    def __init__(self, facade: "Stoke"):
+        object.__setattr__(self, "_facade", facade)
+
+    def train(self):
+        self._facade._training = True
+        return self
+
+    def eval(self):
+        self._facade._training = False
+        return self
+
+    def __getattr__(self, name):
+        return getattr(self._facade._module, name)
+
+
+class Stoke:
+    def __init__(
+        self,
+        model,
+        optimizer: StokeOptimizer | dict,
+        loss: Callable,
+        batch_size_per_device: int = 1,
+        verbose: bool = False,
+        gpu: bool = False,  # parity no-op (device comes from the runtime)
+        fp16: str | None = None,
+        distributed: str | None = None,
+        fairscale_oss: bool = False,
+        fairscale_sddp: bool = False,
+        fairscale_fsdp: bool = False,
+        grad_accum_steps: int = 1,
+        configs: list | None = None,
+        grad_clip: ClipGradNormConfig | None = None,
+        *,
+        sample_input=None,
+        pretrained=None,
+        mesh=None,
+        rng_seed: int = 0,
+    ):
+        _dist.initialize()
+        self._module = model
+        self._loss_callable = loss
+        self.batch_size_per_device = int(batch_size_per_device)
+        self.verbose = bool(verbose)
+        self.grad_accum_steps = max(1, int(grad_accum_steps))
+        self.grad_clip = grad_clip
+        self._training = True
+
+        # -- configs (list surface, Stoke-DDP.py:252) ----------------------
+        self._configs = list(configs or [])
+        self.amp_config = self._find_config(AMPConfig) or AMPConfig()
+        self.ddp_config = self._find_config(DDPConfig) or DDPConfig()
+        self.oss_config = self._find_config(FairscaleOSSConfig) or FairscaleOSSConfig()
+        self.tpu_config = self._find_config(TPUConfig) or TPUConfig()
+        ds_config = self._find_config(DeepspeedConfig)
+
+        # -- distribution policy ------------------------------------------
+        distributed = (
+            distributed.value
+            if isinstance(distributed, DistributedOptions)
+            else distributed
+        )
+        if ds_config is not None and ds_config.zero_optimization is not None:
+            stage = ds_config.zero_optimization.stage
+            fairscale_oss = fairscale_oss or stage >= 1
+            fairscale_sddp = fairscale_sddp or stage >= 2
+            fairscale_fsdp = fairscale_fsdp or stage >= 3
+        self.policy = policy_from_flags(
+            distributed=distributed,
+            fairscale_oss=fairscale_oss,
+            fairscale_sddp=fairscale_sddp,
+            fairscale_fsdp=fairscale_fsdp,
+            remat=self.tpu_config.remat,
+        )
+        zero = fairscale_oss or fairscale_sddp or fairscale_fsdp
+        if mesh is not None:
+            self.mesh = mesh
+        elif self.tpu_config.dp or self.tpu_config.fsdp > 1 or self.tpu_config.tp > 1:
+            self.mesh = make_mesh(
+                MeshSpec(
+                    dp=self.tpu_config.dp or 1,
+                    fsdp=self.tpu_config.fsdp,
+                    tp=self.tpu_config.tp,
+                    sp=self.tpu_config.sp,
+                )
+            )
+        else:
+            self.mesh = make_mesh(MeshSpec.zero() if zero else MeshSpec.ddp())
+
+        # -- precision -----------------------------------------------------
+        fp16 = fp16.value if isinstance(fp16, FP16Options) else fp16
+        self.fp16 = fp16
+        if fp16 in ("amp", "apex_O1", "apex_O2", "deepspeed"):
+            self.precision = PrecisionPolicy.from_name("fp16")
+            self.loss_scaler = DynamicLossScaler(
+                init_scale=self.amp_config.init_scale,
+                growth_factor=self.amp_config.growth_factor,
+                backoff_factor=self.amp_config.backoff_factor,
+                growth_interval=self.amp_config.growth_interval,
+            )
+        elif fp16 == "bf16":
+            self.precision = PrecisionPolicy.from_name("bf16")
+            self.loss_scaler = None
+        elif fp16 is None:
+            self.precision = PrecisionPolicy()
+            self.loss_scaler = None
+        else:
+            raise ValueError(f"unknown fp16 option {fp16!r}")
+
+        # -- optimizer -----------------------------------------------------
+        factory, kwargs = StokeOptimizer.resolve(optimizer)
+        self._base_lr = float(kwargs.pop("lr", 1e-3))
+        if grad_clip is not None:
+            kwargs.setdefault("clip_grad_norm", grad_clip.max_norm)
+        # lr=1.0: the real lr rides the OptimizerHandle and is applied as a
+        # runtime scalar, so torch-style schedulers never retrace anything
+        self._tx = factory(lr=1.0, **kwargs)
+        self._opt_handle = optim_mod.OptimizerHandle(self._base_lr)
+
+        # -- lazy-built state ---------------------------------------------
+        self._state = None
+        self._shardings = None
+        self._fused = None
+        self._pending_pretrained = pretrained
+        self._rng_seed = rng_seed
+        self._ema_loss = None
+        self._last_inputs = None
+        self._last_targets = None
+        self._last_loss = None
+        self._backward_count = 0
+        self._grad_acc = None
+        self._accepts_train = self._model_accepts("train")
+
+        if sample_input is not None:
+            self.init(sample_input)
+
+    # -- init / state ------------------------------------------------------
+
+    def _find_config(self, cls):
+        for c in self._configs:
+            if isinstance(c, cls):
+                return c
+        return None
+
+    def _model_accepts(self, kwarg: str) -> bool:
+        try:
+            sig = inspect.signature(type(self._module).__call__)
+            return kwarg in sig.parameters
+        except (TypeError, ValueError):
+            return False
+
+    def init(self, sample_input) -> "Stoke":
+        """Initialize (sharded) params from a sample input. Called
+        automatically by the first ``.model(inputs)``."""
+        if self._state is not None:
+            return self
+        sample = jax.tree.map(
+            lambda x: jnp.asarray(x)[:1] if hasattr(x, "shape") else x, sample_input
+        )
+        init_kwargs = {"train": False} if self._accepts_train else {}
+        self._state, self._shardings = create_train_state(
+            model=self._module,
+            sample_input=sample,
+            tx=self._tx,
+            mesh=self.mesh,
+            policy=self.policy,
+            rng=jax.random.PRNGKey(self._rng_seed),
+            scaler_state=self.loss_scaler.init() if self.loss_scaler else None,
+            init_kwargs=init_kwargs,
+        )
+        self._build_jits()
+        if self._pending_pretrained is not None:
+            self.load_model_state(self._pending_pretrained)
+            self._pending_pretrained = None
+        if self.verbose:
+            n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self._state.params))
+            self.print_on_devices(
+                f"Stoke[tpu]: {type(self._module).__name__} {n/1e6:.2f}M params, "
+                f"policy={self.policy.name}, mesh={dict(self.mesh.shape)}, "
+                f"precision={self.fp16 or 'fp32'}, accum={self.grad_accum_steps}"
+            )
+        return self
+
+    def _apply_model(self, params, model_state, x, train: bool, rng):
+        variables = {"params": params, **model_state}
+        kwargs = {}
+        if self._accepts_train:
+            kwargs["train"] = train
+        mutable = [k for k in model_state] if (train and model_state) else False
+        rngs = {"dropout": rng} if rng is not None else None
+        if mutable:
+            out, new_state = self._module.apply(
+                variables, x, rngs=rngs, mutable=mutable, **kwargs
+            )
+            return out, dict(new_state)
+        out = self._module.apply(variables, x, rngs=rngs, **kwargs)
+        return out, model_state
+
+    def _build_jits(self):
+        precision = self.precision
+        loss_callable = self._loss_callable
+
+        def fwd(params, model_state, x, rng, train: bool):
+            pc = precision.cast_to_compute(params)
+            out, new_state = self._apply_model(pc, model_state, x, train, rng)
+            return precision.cast_to_output(out), new_state
+
+        self._jit_fwd = jax.jit(fwd, static_argnames=("train",))
+        self._jit_loss = jax.jit(lambda o, t: loss_callable(o, t))
+
+        def loss_grad(params, model_state, x, y, rng, scaler_state):
+            def lfn(p):
+                out, new_state = self._apply_model(
+                    precision.cast_to_compute(p), model_state, x, True, rng
+                )
+                loss = loss_callable(out, y)
+                scaled = (
+                    loss * scaler_state.scale.astype(loss.dtype)
+                    if scaler_state is not None
+                    else loss
+                )
+                return scaled, (loss, new_state)
+
+            (_, (loss, new_state)), grads = jax.value_and_grad(lfn, has_aux=True)(
+                params
+            )
+            return loss, new_state, grads
+
+        self._jit_loss_grad = jax.jit(loss_grad)
+
+        accum = self.grad_accum_steps
+
+        def acc(buf, grads):
+            g32 = jax.tree.map(lambda g: g.astype(jnp.float32) / accum, grads)
+            return g32 if buf is None else jax.tree.map(jnp.add, buf, g32)
+
+        self._jit_acc_first = jax.jit(lambda g: acc(None, g))
+        self._jit_acc = jax.jit(acc)
+
+        tx = self._tx
+        policy = self.policy
+        mesh = self.mesh
+        scaler = self.loss_scaler
+
+        def apply_updates(params, opt_state, scaler_state, grads, lr):
+            finite = jnp.bool_(True)
+            new_scaler = scaler_state
+            if scaler is not None and scaler_state is not None:
+                grads = scaler.unscale_grads(grads, scaler_state)
+                finite = DynamicLossScaler.grads_finite(grads)
+                new_scaler = scaler.update(scaler_state, finite)
+            gspecs = policy.grads_specs(params, mesh)
+            if gspecs is not None:
+                grads = constrain(grads, gspecs, mesh)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            updates = jax.tree.map(lambda u: u * lr, updates)
+            new_params = jax.tree.map(lambda p, u: p + u, params, updates)
+            if scaler is not None:
+                new_params = jax.tree.map(
+                    lambda n, o: jnp.where(finite, n, o), new_params, params
+                )
+                new_opt = jax.tree.map(
+                    lambda n, o: jnp.where(finite, n, o), new_opt, opt_state
+                )
+            return new_params, new_opt, new_scaler
+
+        self._jit_apply = jax.jit(
+            apply_updates,
+            in_shardings=(
+                self._shardings.params,
+                self._shardings.opt_state,
+                self._shardings.scaler,
+                None,
+                None,
+            ),
+            out_shardings=(
+                self._shardings.params,
+                self._shardings.opt_state,
+                self._shardings.scaler,
+            ),
+            donate_argnums=(0, 1),
+        )
+
+    # -- eager-parity runtime surface --------------------------------------
+
+    def model(self, inputs):
+        """Forward pass (`Stoke-DDP.py:73,116`). Lazily initializes params
+        from the first batch's shapes."""
+        if self._state is None:
+            self.init(inputs)
+        inputs = self._shard_batch(inputs)
+        self._last_inputs = inputs
+        rng = jax.random.fold_in(self._state.rng, self._state.step)
+        out, _ = self._jit_fwd(
+            self._state.params, self._state.model_state, inputs, rng,
+            train=self._training,
+        )
+        return out
+
+    def loss(self, outputs, targets):
+        """Loss computation (`Stoke-DDP.py:74,118`)."""
+        targets = self._shard_batch(targets)
+        self._last_targets = targets
+        loss = self._jit_loss(outputs, targets)
+        self._note_loss(loss)
+        return loss
+
+    def backward(self, loss=None):
+        """Backward (`Stoke-DDP.py:79`): recomputes fwd+loss under grad on
+        the recorded (inputs, targets) pair and accumulates ``grads/accum``.
+        The ``loss`` argument is accepted for API parity; gradients come
+        from the compiled loss_grad program."""
+        if self._last_inputs is None or self._last_targets is None:
+            raise RuntimeError(
+                "backward() needs a preceding model(inputs) and loss(outputs, targets)"
+            )
+        rng = jax.random.fold_in(self._state.rng, self._state.step)
+        loss_val, new_model_state, grads = self._jit_loss_grad(
+            self._state.params,
+            self._state.model_state,
+            self._last_inputs,
+            self._last_targets,
+            rng,
+            self._state.scaler,
+        )
+        self._state = self._state.replace(model_state=new_model_state)
+        self._grad_acc = (
+            self._jit_acc_first(grads)
+            if self._grad_acc is None
+            else self._jit_acc(self._grad_acc, grads)
+        )
+        self._backward_count += 1
+        self._note_loss(loss_val)
+        return loss_val
+
+    def step(self):
+        """Optimizer step (`Stoke-DDP.py:82`): fires every
+        ``grad_accum_steps``-th call (Stoke accumulation semantics)."""
+        if self._backward_count == 0:
+            return
+        if self._backward_count % self.grad_accum_steps != 0:
+            return
+        new_params, new_opt, new_scaler = self._jit_apply(
+            self._state.params,
+            self._state.opt_state,
+            self._state.scaler,
+            self._grad_acc,
+            jnp.float32(self._opt_handle.lr),
+        )
+        self._state = self._state.replace(
+            params=new_params,
+            opt_state=new_opt,
+            scaler=new_scaler,
+            step=self._state.step + 1,
+        )
+        self._grad_acc = None
+        self._backward_count = 0
+
+    def zero_grad(self):
+        """Drop accumulated grads (raw-loop parity, `Fairscale-DDP.py:97`)."""
+        self._grad_acc = None
+        self._backward_count = 0
+
+    def detach_and_sync_loss(self, loss):
+        """Cross-device mean of a loss for reporting (`Stoke-DDP.py:86`).
+        Under SPMD the compiled loss is already the global mean; this pulls
+        it to host as a float."""
+        return sync_scalar(loss)
+
+    # -- fused fast path ---------------------------------------------------
+
+    def fused_step(self, inputs, targets):
+        """One compiled program for fwd+bwd+accum+clip+update — the TPU fast
+        path. Returns the metrics dict. State is shared with the eager
+        surface, so the two paths can be mixed."""
+        if self._state is None:
+            self.init(inputs)
+        if self._fused is None:
+            module_apply = self._apply_model
+            loss_callable = self._loss_callable
+
+            def loss_fn(params, batch, rng, model_state):
+                x, y = batch
+                out, new_state = module_apply(params, model_state, x, True, rng)
+                loss = loss_callable(out, y)
+                aux = {"model_state": new_state} if new_state else {}
+                return loss, aux
+
+            self._fused = TrainStep(
+                loss_fn,
+                self._tx,
+                self.mesh,
+                self.policy,
+                grad_accum_steps=self.grad_accum_steps,
+                precision=self.precision,
+                loss_scaler=self.loss_scaler,
+                state_shardings=self._shardings,
+                donate=self.tpu_config.donate_state,
+            )
+        self._state, metrics = self._fused(
+            self._state,
+            (self._shard_batch(inputs), self._shard_batch(targets)),
+            lr_factor=self._opt_handle.lr,
+        )
+        self._note_loss(metrics["loss"])
+        return metrics
+
+    # -- data --------------------------------------------------------------
+
+    def DataLoader(
+        self,
+        dataset,
+        batch_size: int | None = None,
+        sampler=None,
+        num_workers: int = 0,
+        drop_last: bool = True,
+        **kwargs,
+    ):
+        """Loader bound to the facade's batch size and mesh
+        (`Stoke-DDP.py:286-298`). Per-process batch =
+        ``batch_size_per_device × local device count``; ``drop_last``
+        defaults True (static shapes — XLA recompiles on ragged tails)."""
+        if batch_size is None:
+            batch_size = self.batch_size_per_device * jax.local_device_count()
+        kwargs.pop("multiprocessing_context", None)  # torch parity no-op
+        return _DataLoader(
+            dataset,
+            batch_size=batch_size,
+            sampler=sampler,
+            num_workers=num_workers,
+            drop_last=drop_last,
+            mesh=self.mesh,
+            spec=batch_spec(self.mesh),
+            **kwargs,
+        )
+
+    def _shard_batch(self, x):
+        if hasattr(x, "sharding") and not isinstance(x, np.ndarray):
+            return x  # already placed (came from our DataLoader)
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(self.mesh, batch_spec(self.mesh))
+        return jax.tree.map(
+            lambda a: jax.make_array_from_process_local_data(sharding, np.asarray(a)),
+            x,
+        )
+
+    # -- checkpoint --------------------------------------------------------
+
+    def save(self, path: str = "./", name: str = "checkpoint", extras: dict | None = None):
+        """Consolidated save → ``(full_path, tag)`` (`Stoke-DDP.py:142-145`).
+        Unlike the reference, optimizer/scaler/step/RNG state is included."""
+        self._require_state()
+        named = {
+            "params": self._state.params,
+            "model_state": self._state.model_state,
+        }
+        positional = {"opt_state": self._state.opt_state}
+        meta = {
+            "step": int(self._state.step),
+            "lr": self._opt_handle.lr,
+            "backward_count": self._backward_count,
+            "rng": np.asarray(jax.random.key_data(self._state.rng)).tolist(),
+            "scaler": None
+            if self._state.scaler is None
+            else {
+                "scale": float(self._state.scaler.scale),
+                "growth_count": int(self._state.scaler.growth_count),
+            },
+            **(extras or {}),
+        }
+        return ckpt.save_checkpoint(path, name, named, positional, meta)
+
+    def load(self, path: str):
+        """Full-state restore (the resume path the reference lacks)."""
+        self._require_state()
+        flat, meta = ckpt.load_checkpoint(path)
+        params = ckpt.load_params_dict(
+            ckpt.extract_tree(flat, "params"), jax.device_get(self._state.params)
+        )
+        opt_state = ckpt.restore_positional(flat, "opt_state", self._state.opt_state)
+        model_state = ckpt.extract_tree(flat, "model_state")
+        scaler = self._state.scaler
+        if meta.get("scaler") and scaler is not None:
+            scaler = scaler.replace(
+                scale=jnp.float32(meta["scaler"]["scale"]),
+                growth_count=jnp.int32(meta["scaler"]["growth_count"]),
+            )
+        rng = self._state.rng
+        if "rng" in meta:
+            rng = jax.random.wrap_key_data(
+                jnp.asarray(meta["rng"], dtype=jnp.uint32)
+            )
+        new = self._state.replace(
+            params=params,
+            opt_state=opt_state,
+            model_state=model_state or self._state.model_state,
+            step=jnp.int32(meta.get("step", 0)),
+            rng=rng,
+            scaler=scaler,
+        )
+        # re-place on the policy's shardings
+        self._state = jax.device_put(new, self._shardings)
+        self._opt_handle.lr = float(meta.get("lr", self._opt_handle.lr))
+        if self.verbose:
+            self.print_on_devices(f"restored checkpoint @ step {int(self._state.step)}")
+
+    def load_model_state(self, source, strict: bool = True, param_key: str = "params"):
+        """Pretrained-weights load with optional ``'params'`` nesting and
+        strict matching (`Stoke-DDP.py:209-213`)."""
+        self._require_state()
+        if isinstance(source, str):
+            flat, _ = ckpt.load_checkpoint(source)
+            source = ckpt.flat_dict_to_tree(flat)
+        params = ckpt.load_params_dict(
+            source, jax.device_get(self._state.params), strict=strict,
+            param_key=param_key,
+        )
+        params = jax.device_put(params, self._shardings.params)
+        self._state = self._state.replace(params=params)
+
+    # -- introspection / rank I/O ------------------------------------------
+
+    @property
+    def world_size(self) -> int:
+        return _dist.world_size()
+
+    @property
+    def rank(self) -> int:
+        return _dist.rank()
+
+    @property
+    def optimizer(self) -> optim_mod.OptimizerHandle:
+        return self._opt_handle
+
+    @property
+    def model_access(self) -> _ModelAccess:
+        return _ModelAccess(self)
+
+    @property
+    def state(self):
+        self._require_state()
+        return self._state
+
+    @property
+    def step_count(self) -> int:
+        return 0 if self._state is None else int(self._state.step)
+
+    def print_on_devices(self, msg: str = ""):
+        """Rank-stamped print (`Stoke-DDP.py:67,130`)."""
+        print(f"[rank {self.rank}/{self.world_size}] {msg}", flush=True)
+
+    def print_ema_loss(self, prepend_msg: str = "EMA Loss"):
+        """Smoothed-loss print (`Stoke-DDP.py:76`)."""
+        if self._ema_loss is not None and self.verbose:
+            print(f"{prepend_msg}: {self._ema_loss:.6f}", flush=True)
+
+    def barrier(self):
+        from ..ops import barrier
+
+        barrier()
+
+    def _note_loss(self, loss):
+        try:
+            val = float(jax.device_get(loss))
+        except (TypeError, jax.errors.ConcretizationTypeError):
+            return
+        self._last_loss = val
+        self._ema_loss = (
+            val if self._ema_loss is None else 0.98 * self._ema_loss + 0.02 * val
+        )
+
+    def _require_state(self):
+        if self._state is None:
+            raise RuntimeError(
+                "Stoke is not initialized — call .init(sample_input) or run a "
+                "first .model(inputs)"
+            )

@@ -1,0 +1,42 @@
+"""StokeOptimizer: the optimizer-spec dict the facade consumes.
+
+Twin of stoke's ``StokeOptimizer`` TypedDict as built at
+`/root/reference/Stoke-DDP.py:226-235`::
+
+    StokeOptimizer(optimizer=AdamW, optimizer_kwargs={"lr": 1e-3, ...})
+
+``optimizer`` may be a string ("adamw"/"sgd"), one of this package's
+factories (:func:`~..optim.adamw`), or a torch-style class object with a
+recognizable ``__name__`` — so the reference's ``optimizer=AdamW`` line
+ports by renaming the import only.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from .. import optim as _optim
+
+
+class StokeOptimizer(dict):
+    """Dict with validation: keys ``optimizer`` and ``optimizer_kwargs``."""
+
+    def __init__(self, optimizer: Any, optimizer_kwargs: dict | None = None):
+        super().__init__(optimizer=optimizer, optimizer_kwargs=optimizer_kwargs or {})
+
+    @staticmethod
+    def resolve(spec: "StokeOptimizer | dict") -> tuple[Callable, dict]:
+        """Return ``(factory, kwargs)`` with torch-parity kwarg names."""
+        opt = spec["optimizer"]
+        kwargs = dict(spec.get("optimizer_kwargs") or {})
+        if callable(opt) and getattr(opt, "__module__", "").startswith(
+            "pytorch_distributedtraining_tpu"
+        ):
+            return opt, kwargs
+        name = opt if isinstance(opt, str) else getattr(opt, "__name__", str(opt))
+        key = name.lower()
+        if key not in _optim.OPTIMIZERS:
+            raise ValueError(
+                f"unknown optimizer {name!r}; known: {sorted(_optim.OPTIMIZERS)}"
+            )
+        return _optim.OPTIMIZERS[key], kwargs

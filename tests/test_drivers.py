@@ -1,0 +1,21 @@
+"""Driver ports: end-to-end smoke on synthetic data (the reference's own
+de-facto test was running the driver, SURVEY §4)."""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_fairscale_driver_trains(capsys):
+    from drivers import fairscale_ddp
+
+    loss = fairscale_ddp.main(
+        ["--synthetic", "--synthetic-n", "96", "--epochs", "2",
+         "--batch-size", "16", "--workers", "0"]
+    )
+    out = capsys.readouterr().out
+    assert "===> Building model" in out
+    assert "--Shape--" in out
+    assert "For Epoch 1" in out
+    assert loss is not None and loss < 0.1
