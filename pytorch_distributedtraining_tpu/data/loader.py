@@ -120,11 +120,26 @@ class DataLoader:
         import jax
         from jax.sharding import NamedSharding
 
-        sharding = NamedSharding(self.mesh, self.spec)
-        return jax.tree.map(
-            lambda a: jax.make_array_from_process_local_data(sharding, np.asarray(a)),
-            batch,
-        )
+        # a ragged tail (drop_last=False) cannot shard across the data axes —
+        # pad by repeating the last sample up to the divisibility requirement
+        # (metrics over a padded tail are marginally biased; a crash is worse)
+        div = 1
+        for ax in self.spec or ():
+            if ax is not None:
+                names = ax if isinstance(ax, (tuple, list)) else (ax,)
+                for n in names:
+                    div *= self.mesh.shape.get(n, 1)
+
+        def place(a):
+            a = np.asarray(a)
+            if div > 1 and a.shape[0] % div:
+                pad = div - (a.shape[0] % div)
+                a = np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, self.spec), a
+            )
+
+        return jax.tree.map(place, batch)
 
     def __iter__(self):
         # snapshot the index order NOW (generators run lazily; the epoch

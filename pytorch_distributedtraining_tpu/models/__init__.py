@@ -30,7 +30,7 @@ _LAZY = {
 
 # only names whose modules exist on disk — grows as the zoo ships; _LAZY may
 # lead it (unshipped names raise AttributeError instead of breaking import *)
-__all__ = ["Net", "pixel_shuffle"]
+__all__ = ["Net", "pixel_shuffle", "SwinIR"]
 
 
 def __getattr__(name):

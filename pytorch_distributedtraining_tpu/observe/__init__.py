@@ -1,0 +1,22 @@
+"""Observability: metrics sinks (W&B-compatible), profiling, step timing.
+
+Twin of the reference's L7 layer (`/root/reference/Stoke-DDP.py`): W&B login
+/init-with-retry/log/finish (`:43,316-325,47-58,339`), rank-aware prints,
+plus the tracing the reference lacks (SURVEY §5) — `jax.profiler` hooks and
+per-step timing.
+"""
+
+from . import wandb_compat as wandb
+from .sink import JSONLSink, MetricsSink, NullSink, WandbSink, make_sink
+from .profiling import StepTimer, trace
+
+__all__ = [
+    "wandb",
+    "MetricsSink",
+    "JSONLSink",
+    "NullSink",
+    "WandbSink",
+    "make_sink",
+    "StepTimer",
+    "trace",
+]

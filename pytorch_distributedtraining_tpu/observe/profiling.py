@@ -1,0 +1,61 @@
+"""Profiling and step timing — capability the reference lacks (SURVEY §5:
+"Tracing/profiling: none").
+
+- :func:`trace`: context manager around ``jax.profiler`` writing a
+  TensorBoard-loadable trace (XLA op-level, HBM, ICI traffic on TPU).
+- :class:`StepTimer`: cheap wall-clock per-step stats with warmup handling
+  (first steps include compilation).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+
+@contextlib.contextmanager
+def trace(logdir: str = "/tmp/jax-trace"):
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclass
+class StepTimer:
+    """Track step wall-times; ``summary()`` gives p50/p90/mean excluding
+    warmup (compile) steps."""
+
+    warmup: int = 2
+    times: list = field(default_factory=list)
+    _t0: float | None = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.times.append(time.perf_counter() - self._t0)
+        self._t0 = None
+
+    def summary(self) -> dict:
+        steady = self.times[self.warmup :] or self.times
+        if not steady:
+            return {}
+        s = sorted(steady)
+        n = len(s)
+        return {
+            "steps": n,
+            "mean_s": sum(s) / n,
+            "p50_s": s[n // 2],
+            "p90_s": s[min(n - 1, int(0.9 * n))],
+            "min_s": s[0],
+        }
+
+    def throughput(self, items_per_step: int) -> float:
+        m = self.summary()
+        return items_per_step / m["mean_s"] if m else 0.0

@@ -1,0 +1,123 @@
+"""Metrics sinks: a thin interface with W&B and offline-JSONL backends.
+
+The reference hardwires wandb (`/root/reference/Stoke-DDP.py:42-58`,
+including an init retry-forever loop `:316-322`). Here the driver logs to a
+``MetricsSink``; the wandb adapter is used when the client is importable and
+logging is enabled, otherwise metrics land in a JSONL file — training never
+blocks on a network service. All sinks are rank-0 gated.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+
+def _is_rank0() -> bool:
+    import jax
+
+    return jax.process_index() == 0
+
+
+class MetricsSink:
+    """Interface: ``log(metrics, step=None)`` + ``finish()``."""
+
+    def log(self, metrics: dict[str, Any], step: int | None = None) -> None:
+        raise NotImplementedError
+
+    def finish(self) -> None:
+        pass
+
+
+class NullSink(MetricsSink):
+    def log(self, metrics, step=None):
+        pass
+
+
+class JSONLSink(MetricsSink):
+    """Offline fallback: one JSON object per log call."""
+
+    def __init__(self, path: str = "metrics.jsonl"):
+        self.path = path
+        self._f = None
+
+    def log(self, metrics, step=None):
+        if not _is_rank0():
+            return
+        if self._f is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._f = open(self.path, "a")
+        rec = {"_time": time.time()}
+        if step is not None:
+            rec["_step"] = int(step)
+        rec.update({k: _scalar(v) for k, v in metrics.items()})
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def finish(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class WandbSink(MetricsSink):
+    """Real W&B client with the reference's retry-loop semantics
+    (`Stoke-DDP.py:316-322`) — but bounded retries and rank-0 gating."""
+
+    def __init__(
+        self,
+        project: str,
+        config: dict | None = None,
+        retry_interval: float = 10.0,
+        max_retries: int = 3,
+        **init_kwargs,
+    ):
+        self._run = None
+        if not _is_rank0():
+            return
+        import wandb  # noqa: F811
+
+        for attempt in range(max_retries):
+            try:
+                self._run = wandb.init(project=project, config=config, **init_kwargs)
+                break
+            except Exception:
+                print("Retrying")
+                time.sleep(retry_interval)
+        else:
+            raise RuntimeError(f"wandb.init failed after {max_retries} attempts")
+        self._wandb = wandb
+
+    def log(self, metrics, step=None):
+        if self._run is None:
+            return
+        self._wandb.log({k: _scalar(v) for k, v in metrics.items()}, step=step)
+
+    def finish(self):
+        if self._run is not None:
+            self._wandb.finish()
+            self._run = None
+
+
+def make_sink(project: str | None = None, config: dict | None = None, **kwargs) -> MetricsSink:
+    """Best sink available: wandb if importable+enabled, else JSONL."""
+    if os.environ.get("WANDB_MODE") == "disabled" or project is None:
+        return JSONLSink(kwargs.get("path", "metrics.jsonl"))
+    try:
+        import wandb  # noqa: F401
+
+        return WandbSink(project, config, **kwargs)
+    except Exception:
+        return JSONLSink(kwargs.get("path", "metrics.jsonl"))
+
+
+def _scalar(v):
+    try:
+        import numpy as np
+
+        arr = np.asarray(v)
+        return arr.item() if arr.ndim == 0 else arr.tolist()
+    except Exception:
+        return v

@@ -1,0 +1,63 @@
+"""Module-level wandb-compatible API, so driver code keeps the reference's
+exact call shape (`/root/reference/Stoke-DDP.py:42-58,316-325,339`)::
+
+    from pytorch_distributedtraining_tpu.observe import wandb
+    wandb.login(); wandb.init(project=..., config=..., reinit=True)
+    wandb.log({...}); wandb.config; wandb.finish()
+
+Backed by the real wandb client when available, otherwise the JSONL sink.
+Safe to call from every rank (rank-0 gated) and idempotent under the
+reference's init-on-every-log bug pattern (`:49,56` — re-init is a no-op
+once a run exists).
+"""
+
+from __future__ import annotations
+
+from .sink import JSONLSink, MetricsSink, make_sink
+
+_sink: MetricsSink | None = None
+_config: dict = {}
+
+
+def login(*args, **kwargs) -> bool:
+    return True
+
+
+def init(project: str | None = None, config: dict | None = None, reinit: bool = False, **kwargs):
+    global _sink, _config
+    if _sink is not None and not reinit:
+        return _sink  # tolerate the reference's init-on-every-log pattern
+    if _sink is not None and reinit:
+        _sink.finish()
+    if config:
+        _config = dict(config)
+    _sink = make_sink(project, config, **kwargs)
+    return _sink
+
+
+def log(metrics: dict, step: int | None = None) -> None:
+    global _sink
+    if _sink is None:
+        _sink = JSONLSink()
+    _sink.log(metrics, step=step)
+
+
+def finish() -> None:
+    global _sink
+    if _sink is not None:
+        _sink.finish()
+        _sink = None
+
+
+class _Config(dict):
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError as e:
+            raise AttributeError(k) from e
+
+
+def __getattr__(name):
+    if name == "config":
+        return _Config(_config)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

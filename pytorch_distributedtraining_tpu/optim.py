@@ -159,16 +159,24 @@ class OneCycleLR:
         self._total = total_steps
         self._warm = max(1, int(total_steps * pct_start))
         self._t = 0
+        # multiplier for composing with ReduceLROnPlateau (factor mode):
+        # a bare torch pairing clobbers the plateau cut on the next batch —
+        # route the cut through lr_scale instead so it persists
+        self.lr_scale = 1.0
         optimizer.lr = self._lr_at(0)
 
     def _lr_at(self, step: int) -> float:
         step = min(step, self._total)
         if step < self._warm:
             up = 0.5 * (1 + math.cos(math.pi * (1 - step / self._warm)))
-            return self._initial + (self._max_lr - self._initial) * up
-        t = min(max((step - self._warm) / max(1, self._total - self._warm), 0.0), 1.0)
-        down = 0.5 * (1 + math.cos(math.pi * t))
-        return self._final + (self._max_lr - self._final) * down
+            lr = self._initial + (self._max_lr - self._initial) * up
+        else:
+            t = min(
+                max((step - self._warm) / max(1, self._total - self._warm), 0.0), 1.0
+            )
+            down = 0.5 * (1 + math.cos(math.pi * t))
+            lr = self._final + (self._max_lr - self._final) * down
+        return lr * self.lr_scale
 
     def step(self) -> float:
         self._t += 1
@@ -176,10 +184,11 @@ class OneCycleLR:
         return self.optimizer.lr
 
     def state_dict(self) -> dict:
-        return {"t": self._t}
+        return {"t": self._t, "lr_scale": self.lr_scale}
 
     def load_state_dict(self, d: dict) -> None:
         self._t = int(d["t"])
+        self.lr_scale = float(d.get("lr_scale", 1.0))
         self.optimizer.lr = self._lr_at(self._t)
 
 

@@ -4,6 +4,8 @@ de-facto test was running the driver, SURVEY §4)."""
 import sys
 import os
 
+import numpy as np
+
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
@@ -19,3 +21,41 @@ def test_fairscale_driver_trains(capsys):
     assert "--Shape--" in out
     assert "For Epoch 1" in out
     assert loss is not None and loss < 0.1
+
+
+def test_stoke_driver_trains(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # checkpoint/ lands in tmp
+    monkeypatch.setenv("WANDB_MODE", "disabled")  # never hit the network
+    from drivers import stoke_ddp
+
+    train_loss, val_loss = stoke_ddp.main(
+        ["--synthetic", "--synthetic-n", "64", "--nEpochs", "1",
+         "--batchSize", "4", "--threads", "0", "--projectName", "test-proj"]
+    )
+    out = capsys.readouterr().out
+    assert "===> Building model" in out
+    assert "VALIDATION" in out
+    assert "Checkpoint saved after epoch 0" in out
+    assert (tmp_path / "checkpoint").exists()
+    assert np.isfinite(train_loss) and np.isfinite(val_loss)
+
+
+def test_stoke_driver_cli_parity():
+    """All 11 reference flags (Stoke-DDP.py:156-173) parse with the same
+    names and defaults."""
+    from drivers import stoke_ddp
+
+    opt = stoke_ddp.build_parser().parse_args([])
+    assert opt.projectName == "Stoke-4K-2X-DDP"
+    assert opt.batchSize == 18
+    assert opt.nEpochs == 10
+    assert opt.start_epoch == 1
+    assert opt.lr == 0.001
+    assert opt.weight_decay == 1e-4
+    assert opt.grad_clip == 0.1
+    assert opt.local_rank == -1
+    assert opt.threads == 16
+    assert "LRPatch_128" in opt.inputDir
+    assert "HR_256" in opt.targetDir
+    # --wd alias works
+    assert stoke_ddp.build_parser().parse_args(["--wd", "0.5"]).weight_decay == 0.5

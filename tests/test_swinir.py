@@ -1,0 +1,112 @@
+"""SwinIR-S: shapes, param budget, window ops, shift masks, training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributedtraining_tpu.models import SwinIR
+from pytorch_distributedtraining_tpu.models.swinir import (
+    _relative_position_index,
+    _shift_attn_mask,
+    window_partition,
+    window_reverse,
+)
+
+
+def _model():
+    # the exact reference config (Stoke-DDP.py:206-208)
+    return SwinIR(
+        upscale=2, in_chans=3, img_size=64, window_size=8, img_range=1.0,
+        depths=[6, 6, 6, 6], embed_dim=60, num_heads=[6, 6, 6, 6],
+        mlp_ratio=2, upsampler="pixelshuffledirect", resi_connection="1conv",
+    )
+
+
+def test_window_partition_roundtrip():
+    x = jnp.arange(2 * 16 * 16 * 3, dtype=jnp.float32).reshape(2, 16, 16, 3)
+    wins = window_partition(x, 8)
+    assert wins.shape == (2 * 4, 64, 3)
+    back = window_reverse(wins, 8, 16, 16)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+
+def test_relative_position_index_bounds():
+    idx = _relative_position_index(8)
+    assert idx.shape == (64, 64)
+    assert idx.min() == 0 and idx.max() == 15 * 15 - 1
+    assert idx[0, 0] == idx[5, 5]  # self-offset always the same bucket
+
+
+def test_shift_mask_blocks_cross_region():
+    mask = _shift_attn_mask(16, 16, 8, 4)
+    assert mask.shape == (4, 64, 64)
+    assert np.all(np.diagonal(mask, axis1=1, axis2=2) == 0)  # self visible
+    assert (mask == -100.0).any()  # some pairs blocked
+
+
+def test_forward_shape_and_param_count():
+    model = _model()
+    x = jnp.zeros((1, 64, 64, 3))
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    # SwinIR-S is ~0.9M params
+    assert 0.7e6 < n < 1.2e6, f"param count {n}"
+    y = jax.jit(model.apply)({"params": params}, x)
+    assert y.shape == (1, 128, 128, 3)
+
+
+def test_forward_non_multiple_of_window():
+    model = _model()
+    x = jnp.zeros((1, 20, 28, 3))  # not multiples of 8 -> pad+crop
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    y = model.apply({"params": params}, x)
+    assert y.shape == (1, 40, 56, 3)
+
+
+def test_shift_changes_output():
+    """Shifted layers must actually mix across window borders."""
+    model = _model()
+    key = jax.random.PRNGKey(1)
+    x = jax.random.uniform(key, (1, 16, 16, 3))
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    y = model.apply({"params": params}, x)
+    # perturb one pixel inside window (0,0); effect must reach a pixel in a
+    # different window (possible only through shifted attention / convs)
+    x2 = x.at[0, 1, 1, 0].add(0.5)
+    y2 = model.apply({"params": params}, x2)
+    far = np.abs(np.asarray(y2 - y))[0, 24:, 24:, :]
+    assert far.max() > 1e-6
+
+
+def test_swinir_trains(mesh8):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_distributedtraining_tpu import optim
+    from pytorch_distributedtraining_tpu.losses import l1_loss
+    from pytorch_distributedtraining_tpu.parallel import DDP, TrainStep, create_train_state
+
+    model = SwinIR(
+        upscale=2, window_size=8, depths=[2], embed_dim=24, num_heads=[4],
+        mlp_ratio=2,
+    )
+
+    def loss_fn(params, batch, rng, model_state):
+        x, y = batch
+        return l1_loss(model.apply({"params": params}, x), y), {}
+
+    tx = optim.adamw(lr=2e-3)
+    state, sh = create_train_state(
+        init_fn=lambda r: (model.init(r, jnp.zeros((1, 16, 16, 3)))["params"], {}),
+        tx=tx, mesh=mesh8, policy=DDP(),
+    )
+    step = TrainStep(loss_fn, tx, mesh8, DDP(), state_shardings=sh)
+    rng = np.random.default_rng(0)
+    hr = rng.random((8, 32, 32, 3)).astype(np.float32)
+    lr = hr.reshape(8, 16, 2, 16, 2, 3).mean(axis=(2, 4))
+    batch = (lr, hr)
+    losses = []
+    for _ in range(8):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
