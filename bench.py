@@ -1,0 +1,104 @@
+"""Headline benchmark: SwinIR-S training-step throughput on one TPU chip.
+
+Measures the flagship config the reference actually trains
+(`/root/reference/Stoke-DDP.py:206-208,159`: SwinIR-S x2, 64x64 LR patches,
+batch 18/device) as images/sec through the compiled DDP train step (forward
++ backward + AdamW + grad clip, bf16 compute). The reference publishes no
+numbers (BASELINE.md), so ``vs_baseline`` reports throughput against an
+A100-class per-chip estimate: SwinIR-S x2 at 64x64 is ~21 GFLOPs/image
+trained; an A100 at ~50% bf16 utilization (~150 TFLOP/s) gives ~7000
+img/s, derated to 6000 for data/optimizer overhead. The ratio is the
+trackable cross-round number; BASELINE.json's north star asks for >=0.70.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+BASELINE_IMG_PER_SEC = 6000.0  # per-chip A100-class estimate; see docstring
+BATCH = 18  # Stoke-DDP.py:159 default batch size per device
+PATCH = 64  # Stoke-DDP.py:207 img_size
+STEPS = 20
+WARMUP = 3
+
+
+def main() -> None:
+    from pytorch_distributedtraining_tpu import optim
+    from pytorch_distributedtraining_tpu.losses import mse_loss
+    from pytorch_distributedtraining_tpu.models import SwinIR
+    from pytorch_distributedtraining_tpu.parallel import (
+        DDP,
+        TrainStep,
+        create_train_state,
+    )
+    from pytorch_distributedtraining_tpu.precision import Policy as Precision
+    from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
+    model = SwinIR(dtype=jnp.bfloat16)  # reference config, bf16 MXU path
+    tx = optim.adamw(lr=5e-4, clip_grad_norm=0.1)  # Stoke-DDP.py:253,164
+    policy = DDP()
+
+    def loss_fn(params, batch, rng, model_state):
+        lr_img, hr_img = batch
+        out = model.apply({"params": params}, lr_img)
+        return mse_loss(out, hr_img), {}
+
+    state, shardings = create_train_state(
+        init_fn=lambda rng: (
+            model.init(rng, jnp.zeros((1, PATCH, PATCH, 3)))["params"],
+            {},
+        ),
+        tx=tx,
+        mesh=mesh,
+        policy=policy,
+        # params stay f32 master copies; compute casts to bf16 in-model
+    )
+    step = TrainStep(
+        loss_fn, tx, mesh, policy,
+        precision=Precision(),
+        state_shardings=shardings,
+        extra_metrics=False,
+        donate=True,
+    )
+
+    rng = np.random.default_rng(0)
+    hr = rng.random((BATCH, 2 * PATCH, 2 * PATCH, 3)).astype(np.float32)
+    lr_img = hr.reshape(BATCH, PATCH, 2, PATCH, 2, 3).mean(axis=(2, 4))
+    batch = (
+        jax.device_put(lr_img, jax.devices()[0]),
+        jax.device_put(hr, jax.devices()[0]),
+    )
+
+    with mesh:
+        for _ in range(WARMUP):
+            state, metrics = step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            state, metrics = step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+    img_per_sec = BATCH * STEPS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "swinir_s_x2_train_images_per_sec_per_chip",
+                "value": round(img_per_sec, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

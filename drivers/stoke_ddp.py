@@ -131,8 +131,7 @@ def validate(val_dataloader, stoke_model: Stoke, epoch):
 
 
 def save_checkpoint(stoke_model, epoch, train_loss, val_loss):
-    if not os.path.exists("checkpoint/"):
-        os.makedirs("checkpoint/")
+    os.makedirs("checkpoint/", exist_ok=True)
     path, tag = stoke_model.save(
         path="checkpoint/",
         name="model_{}_{:.2f}_{:.2f}".format(epoch, train_loss, val_loss),
@@ -168,8 +167,9 @@ def build_parser():
 def main(argv=None):
     # (the reference's `os.environ['LOCAL_RANK'] = str(os.getenv(...))` :153
     # poisons an unset var with the string "None" — dropped, the LOCAL_RANK
-    # read below handles both unset and "None")
-    os.environ["PYTHONWARNINGS"] = "ignore:semaphore_tracker:UserWarning"
+    # read below handles both unset and "None"; its PYTHONWARNINGS
+    # semaphore_tracker silencer :154 is dropped too — no multiprocessing
+    # workers exist in this port, and the var is only read at startup)
 
     global opt
     opt = build_parser().parse_args(argv)

@@ -122,13 +122,17 @@ class DataLoader:
 
         # a ragged tail (drop_last=False) cannot shard across the data axes —
         # pad by repeating the last sample up to the divisibility requirement
-        # (metrics over a padded tail are marginally biased; a crash is worse)
+        # (metrics over a padded tail are marginally biased; a crash is worse).
+        # Only the batch dim (spec[0]) can be padded; other dims are fixed by
+        # the model and must already divide their mesh axes.
         div = 1
-        for ax in self.spec or ():
-            if ax is not None:
-                names = ax if isinstance(ax, (tuple, list)) else (ax,)
-                for n in names:
-                    div *= self.mesh.shape.get(n, 1)
+        batch_ax = self.spec[0] if self.spec else None
+        if batch_ax is not None:
+            names = (
+                batch_ax if isinstance(batch_ax, (tuple, list)) else (batch_ax,)
+            )
+            for n in names:
+                div *= self.mesh.shape.get(n, 1)
 
         def place(a):
             a = np.asarray(a)

@@ -29,8 +29,8 @@ def init(project: str | None = None, config: dict | None = None, reinit: bool = 
         return _sink  # tolerate the reference's init-on-every-log pattern
     if _sink is not None and reinit:
         _sink.finish()
-    if config:
-        _config = dict(config)
+    # a new run always gets a fresh config — never the previous run's
+    _config = dict(config or {})
     _sink = make_sink(project, config, **kwargs)
     return _sink
 

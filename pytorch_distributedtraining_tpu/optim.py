@@ -253,6 +253,8 @@ class ReduceLROnPlateau:
                     )
                     if self.verbose:
                         print(f"ReduceLROnPlateau: lr -> {self.optimizer.lr:.3e}")
+                elif self.verbose:
+                    print(f"ReduceLROnPlateau: lr_factor -> {self.current:.3e}")
                 self._bad = 0
                 self._cool = self.cooldown
         return self.current
