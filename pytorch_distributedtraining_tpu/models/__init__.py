@@ -21,16 +21,18 @@ _LAZY = {
     "SwinIR": ".swinir",
     "ResNet": ".resnet",
     "ResNet18": ".resnet",
+    "ResNet34": ".resnet",
     "ResNet50": ".resnet",
+    "ResNet101": ".resnet",
     "GPT2": ".gpt2",
     "GPT2Config": ".gpt2",
+    "cross_entropy_loss": ".gpt2",
     "ViT": ".vit",
+    "ViTConfig": ".vit",
     "ViTB16": ".vit",
 }
 
-# only names whose modules exist on disk — grows as the zoo ships; _LAZY may
-# lead it (unshipped names raise AttributeError instead of breaking import *)
-__all__ = ["Net", "pixel_shuffle", "SwinIR"]
+__all__ = sorted(_LAZY)
 
 
 def __getattr__(name):

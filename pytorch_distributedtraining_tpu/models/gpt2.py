@@ -1,0 +1,143 @@
+"""GPT-2 causal LM — BASELINE ladder config 4 ("FSDP GPT-2 125M").
+
+The reference's capability contract (BASELINE.json, written against the
+Fairscale FSDP surface Stoke exposes — `/root/reference/Stoke-DDP.py:249-250`
+flag family) ladders through GPT-2 125M under ZeRO-3. Decoder-only
+transformer, pre-LN, learned positional embeddings, tied LM head.
+
+TPU-native choices:
+  - [B, T, D] activations, fused QKV projection — one big MXU matmul.
+  - ``attn_fn`` is pluggable: default is XLA softmax attention (fused by the
+    compiler); `ops.pallas_attn.flash_attention` or
+    `ops.ring_attention.ring_attention` slot in for long context / sp.
+  - Param layout is Megatron-friendly under pjit: sharding the QKV/MLP-in
+    kernels on the output dim and proj/MLP-out on the input dim over "tp"
+    yields the classic two-allreduce-per-block pattern from XLA, no manual
+    collectives (see parallel/tensor.py rules).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+AttnFn = Callable[..., jnp.ndarray]  # (q, k, v, *, causal) -> out
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+    tie_word_embeddings: bool = True
+    remat: bool = False  # checkpoint each block (FSDP memory, SURVEY §7c)
+
+    @staticmethod
+    def gpt2_125m() -> "GPT2Config":
+        return GPT2Config()  # the 125M point IS the default config
+
+    @staticmethod
+    def tiny(**kw) -> "GPT2Config":
+        base = dict(vocab_size=256, n_positions=64, n_embd=32, n_layer=2,
+                    n_head=2, dtype=jnp.float32)
+        base.update(kw)
+        return GPT2Config(**base)
+
+
+def default_attention(q, k, v, *, causal: bool = True):
+    """XLA softmax attention. q/k/v: [B, T, H, Dh] -> [B, T, H, Dh]."""
+    dh = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(dh).astype(q.dtype)
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block: LN → attn → +res, LN → MLP → +res."""
+
+    cfg: GPT2Config
+    attn_fn: AttnFn = default_attention
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.cfg
+        d, h = cfg.n_embd, cfg.n_head
+        dense = lambda feat, name: nn.Dense(  # noqa: E731
+            feat, dtype=cfg.dtype, name=name,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+
+        y = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
+        qkv = dense(3 * d, "c_attn")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        reshape = lambda a: a.reshape(*a.shape[:2], h, d // h)  # noqa: E731
+        y = self.attn_fn(reshape(q), reshape(k), reshape(v), causal=True)
+        y = y.reshape(*y.shape[:2], d)
+        y = dense(d, "c_proj")(y)
+        y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        x = x + y
+
+        y = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        y = dense(cfg.mlp_ratio * d, "mlp_fc")(y)
+        y = nn.gelu(y, approximate=True)
+        y = dense(d, "mlp_proj")(y)
+        y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return x + y
+
+
+class GPT2(nn.Module):
+    """GPT-2 LM. ``__call__(tokens [B, T]) -> logits [B, T, vocab]``."""
+
+    cfg: GPT2Config = GPT2Config()
+    attn_fn: AttnFn = default_attention
+
+    @nn.compact
+    def __call__(self, tokens, deterministic: bool = True):
+        cfg = self.cfg
+        b, t = tokens.shape
+        wte = self.param(
+            "wte", nn.initializers.normal(0.02), (cfg.vocab_size, cfg.n_embd)
+        )
+        wpe = self.param(
+            "wpe", nn.initializers.normal(0.01), (cfg.n_positions, cfg.n_embd)
+        )
+        x = wte[tokens].astype(cfg.dtype) + wpe[:t].astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, static_argnums=(2,))  # (self, x, det)
+        for i in range(cfg.n_layer):
+            x = block_cls(cfg, self.attn_fn, name=f"h_{i}")(x, deterministic)
+
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        if cfg.tie_word_embeddings:
+            logits = x @ wte.T.astype(cfg.dtype)
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                name="lm_head",
+            )(x)
+        return logits.astype(jnp.float32)
+
+
+def cross_entropy_loss(logits, targets, ignore_index: int = -100):
+    """Token-level CE with ignore mask; logits [B,T,V], targets [B,T]."""
+    mask = (targets != ignore_index).astype(jnp.float32)
+    safe = jnp.where(targets == ignore_index, 0, targets)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

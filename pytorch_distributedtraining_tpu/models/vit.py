@@ -1,0 +1,119 @@
+"""ViT-B/16 — BASELINE ladder config 5 ("bf16 + FSDP ViT-B/16 ImageNet").
+
+Vision Transformer (Dosovitskiy et al.): patchify via a strided conv (one
+MXU matmul per image), prepend CLS token, learned position embeddings,
+pre-LN encoder blocks, CLS-pooled classification head. NHWC inputs.
+
+Shares the pluggable ``attn_fn`` contract with models/gpt2.py so the same
+Pallas / ring-attention kernels drop in (non-causal here).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .gpt2 import default_attention
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
+
+    @staticmethod
+    def b16() -> "ViTConfig":
+        return ViTConfig()  # ViT-B/16 IS the default config
+
+    @staticmethod
+    def tiny(**kw) -> "ViTConfig":
+        base = dict(image_size=32, patch_size=8, num_classes=10,
+                    hidden_dim=32, num_layers=2, num_heads=2, mlp_dim=64,
+                    dtype=jnp.float32)
+        base.update(kw)
+        return ViTConfig(**base)
+
+
+class EncoderBlock(nn.Module):
+    cfg: ViTConfig
+    attn_fn: Callable = default_attention
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.cfg
+        d, h = cfg.hidden_dim, cfg.num_heads
+        dense = partial(nn.Dense, dtype=cfg.dtype,
+                        kernel_init=nn.initializers.xavier_uniform())
+
+        y = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
+        qkv = dense(3 * d, name="c_attn")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        reshape = lambda a: a.reshape(*a.shape[:2], h, d // h)  # noqa: E731
+        y = self.attn_fn(reshape(q), reshape(k), reshape(v), causal=False)
+        y = y.reshape(*y.shape[:2], d)
+        y = dense(d, name="c_proj")(y)
+        y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        x = x + y
+
+        y = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        y = dense(cfg.mlp_dim, name="mlp_fc")(y)
+        y = nn.gelu(y)
+        y = dense(d, name="mlp_proj")(y)
+        y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return x + y
+
+
+class ViT(nn.Module):
+    """ViT classifier. ``__call__(images [B,H,W,C]) -> logits``."""
+
+    cfg: ViTConfig = ViTConfig()
+    attn_fn: Callable = default_attention
+
+    @nn.compact
+    def __call__(self, images, deterministic: bool = True):
+        cfg = self.cfg
+        p, d = cfg.patch_size, cfg.hidden_dim
+        x = nn.Conv(
+            d, (p, p), strides=(p, p), padding="VALID", dtype=cfg.dtype,
+            name="patch_embed",
+        )(images.astype(cfg.dtype))
+        b, gh, gw, _ = x.shape
+        x = x.reshape(b, gh * gw, d)
+
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, d))
+        x = jnp.concatenate([jnp.tile(cls.astype(cfg.dtype), (b, 1, 1)), x], 1)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, gh * gw + 1, d)
+        )
+        x = x + pos.astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        block_cls = EncoderBlock
+        if cfg.remat:
+            block_cls = nn.remat(EncoderBlock, static_argnums=(2,))  # (self, x, det)
+        for i in range(cfg.num_layers):
+            x = block_cls(cfg, self.attn_fn, name=f"encoder_{i}")(
+                x, deterministic
+            )
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        x = x[:, 0]  # CLS pool
+        logits = nn.Dense(
+            cfg.num_classes, dtype=cfg.dtype,
+            kernel_init=nn.initializers.zeros, name="head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+ViTB16 = partial(ViT, cfg=ViTConfig.b16())
