@@ -1,0 +1,231 @@
+"""BASELINE ladder benchmarks — the five configs from BASELINE.json.
+
+  1. ResNet-18 CIFAR-10, single process (CPU reference point)
+  2. ResNet-50 DDP (grad psum over dp)
+  3. ResNet-50 OSS + ShardedDDP (ZeRO-2: opt-state shard + grad reduce-scatter)
+  4. GPT-2 125M FSDP (ZeRO-3: param all-gather + grad reduce-scatter)
+  5. ViT-B/16 bf16 + FSDP
+
+Each run prints one JSON line: {config, metric, value, unit, mesh, steps}.
+``--tiny`` shrinks models/batches for CPU smoke runs (used by tests);
+real-chip numbers come from running without it on TPU. ``bench.py`` at the
+repo root stays the driver's single headline number; this file is the
+tracking ladder appended to BASELINE.md across rounds.
+
+Usage:
+    python benchmarks/ladder.py --config 4 [--tiny] [--steps 20]
+    python benchmarks/ladder.py --all --tiny
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _timed_steps(step, state, batch, n_steps, warmup):
+    import jax
+
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    return time.perf_counter() - t0
+
+
+def _mesh_for(policy_kind: str, tiny: bool):
+    import jax
+    from pytorch_distributedtraining_tpu.runtime.mesh import (
+        MeshSpec, make_mesh,
+    )
+
+    n = jax.device_count()
+    if policy_kind == "single":
+        return make_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
+    if policy_kind == "dp":
+        return make_mesh(MeshSpec.ddp(n))
+    return make_mesh(MeshSpec.zero(n))
+
+
+def _run_image(name, model, batch_size, img, policy, mesh, steps, warmup,
+               n_classes=1000):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from pytorch_distributedtraining_tpu import optim
+    from pytorch_distributedtraining_tpu.parallel import (
+        TrainStep, create_train_state,
+    )
+
+    tx = optim.adamw(lr=1e-3, clip_grad_norm=1.0)
+
+    def loss_fn(params, batch, rng, model_state):
+        x, y = batch
+        out = model.apply(
+            {"params": params, **model_state}, x, train=True,
+            mutable=["batch_stats"],
+        ) if model_state else (model.apply({"params": params}, x), None)
+        if isinstance(out, tuple) and out[1] is not None:
+            logits, mut = out
+            aux = {"model_state": mut}
+        else:
+            logits = out[0] if isinstance(out, tuple) else out
+            aux = {}
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).mean()
+        return loss, aux
+
+    def init_fn(rng):
+        variables = model.init(rng, jnp.zeros((1,) + img))
+        variables = dict(variables)
+        params = variables.pop("params")
+        return params, variables
+
+    state, shardings = create_train_state(
+        init_fn=init_fn, tx=tx, mesh=mesh, policy=policy,
+    )
+    step = TrainStep(
+        loss_fn, tx, mesh, policy, state_shardings=shardings,
+        extra_metrics=False,
+    )
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch_size,) + img).astype(np.float32)
+    y = (rng.integers(0, n_classes, size=(batch_size,))).astype(np.int32)
+    with mesh:
+        dt = _timed_steps(step, state, (x, y), steps, warmup)
+    return {
+        "config": name,
+        "metric": "images_per_sec",
+        "value": round(batch_size * steps / dt, 2),
+        "unit": "images/sec",
+        "mesh": dict(mesh.shape),
+        "steps": steps,
+    }
+
+
+def _run_lm(name, cfg, batch_size, seq, policy, mesh, steps, warmup):
+    import jax.numpy as jnp
+    from pytorch_distributedtraining_tpu import optim
+    from pytorch_distributedtraining_tpu.models import GPT2
+    from pytorch_distributedtraining_tpu.models.gpt2 import cross_entropy_loss
+    from pytorch_distributedtraining_tpu.parallel import (
+        TrainStep, create_train_state,
+    )
+
+    model = GPT2(cfg)
+    tx = optim.adamw(lr=3e-4, clip_grad_norm=1.0)
+
+    def loss_fn(params, batch, rng, model_state):
+        logits = model.apply({"params": params}, batch)
+        return cross_entropy_loss(logits[:, :-1], batch[:, 1:]), {}
+
+    state, shardings = create_train_state(
+        init_fn=lambda r: (
+            model.init(r, jnp.zeros((1, 8), jnp.int32))["params"], {},
+        ),
+        tx=tx, mesh=mesh, policy=policy,
+    )
+    step = TrainStep(
+        loss_fn, tx, mesh, policy, state_shardings=shardings,
+        extra_metrics=False,
+    )
+    tok = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(batch_size, seq)
+    ).astype(np.int32)
+    with mesh:
+        dt = _timed_steps(step, state, tok, steps, warmup)
+    return {
+        "config": name,
+        "metric": "tokens_per_sec",
+        "value": round(batch_size * seq * steps / dt, 2),
+        "unit": "tokens/sec",
+        "mesh": dict(mesh.shape),
+        "steps": steps,
+    }
+
+
+def run_config(i: int, tiny: bool, steps: int, warmup: int):
+    from pytorch_distributedtraining_tpu.models import (
+        GPT2Config, ResNet18, ResNet50, ViT, ViTConfig,
+    )
+    from pytorch_distributedtraining_tpu.parallel import DDP, ZeRO2, ZeRO3
+    import jax.numpy as jnp
+
+    if i == 1:
+        model = ResNet18(num_classes=10, small_inputs=True)
+        return _run_image(
+            "1_resnet18_cifar10_single", model, 8 if tiny else 128,
+            (32, 32, 3), DDP(), _mesh_for("single", tiny), steps, warmup,
+            n_classes=10,
+        )
+    if i == 2:
+        model = ResNet18(num_classes=10, small_inputs=True) if tiny else ResNet50()
+        img = (32, 32, 3) if tiny else (224, 224, 3)
+        bs = 8 if tiny else 64
+        return _run_image(
+            "2_resnet50_ddp", model, bs, img, DDP(), _mesh_for("dp", tiny),
+            steps, warmup, n_classes=10 if tiny else 1000,
+        )
+    if i == 3:
+        model = ResNet18(num_classes=10, small_inputs=True) if tiny else ResNet50()
+        img = (32, 32, 3) if tiny else (224, 224, 3)
+        bs = 8 if tiny else 64
+        return _run_image(
+            "3_resnet50_oss_sddp", model, bs, img,
+            ZeRO2(min_shard_size=1 if tiny else 1024),
+            _mesh_for("zero", tiny), steps, warmup,
+            n_classes=10 if tiny else 1000,
+        )
+    if i == 4:
+        cfg = GPT2Config.tiny() if tiny else GPT2Config.gpt2_125m()
+        return _run_lm(
+            "4_gpt2_125m_fsdp", cfg, 8 if tiny else 8, 32 if tiny else 512,
+            ZeRO3(min_shard_size=1 if tiny else 1024, remat=not tiny),
+            _mesh_for("zero", tiny), steps, warmup,
+        )
+    if i == 5:
+        cfg = ViTConfig.tiny() if tiny else ViTConfig.b16()
+        model = ViT(cfg)
+        img = (cfg.image_size, cfg.image_size, 3)
+        return _run_image(
+            "5_vitb16_bf16_fsdp", model, 8 if tiny else 64, img,
+            ZeRO3(min_shard_size=1 if tiny else 1024),
+            _mesh_for("zero", tiny), steps, warmup,
+            n_classes=cfg.num_classes,
+        )
+    raise ValueError(f"config {i} not in 1..5")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", type=int, default=None)
+    parser.add_argument("--all", action="store_true")
+    parser.add_argument("--tiny", action="store_true")
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--warmup", type=int, default=2)
+    parser.add_argument(
+        "--virtual", type=int, default=None, metavar="N",
+        help="force an N-device virtual CPU backend (the image's "
+        "sitecustomize latches the TPU platform before env vars apply, "
+        "so this must go through the jax config API)",
+    )
+    opt = parser.parse_args(argv)
+    if opt.virtual:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", opt.virtual)
+    configs = range(1, 6) if opt.all or opt.config is None else [opt.config]
+    for i in configs:
+        print(json.dumps(run_config(i, opt.tiny, opt.steps, opt.warmup)))
+
+
+if __name__ == "__main__":
+    main()

@@ -27,7 +27,12 @@ from .sampler import DistributedSampler
 
 
 def default_collate(samples):
-    """Stack a list of samples; tuples/lists/namedtuples collate per-field."""
+    """Stack a list of samples; tuples/lists/namedtuples collate per-field.
+
+    Leaf stacking goes through the native fastpipe collate (csrc/: parallel
+    memcpy across samples — the torch C++ collate/pin-memory twin) when the
+    extension is built, else numpy.
+    """
     first = samples[0]
     if isinstance(first, tuple) and hasattr(first, "_fields"):  # namedtuple
         return type(first)(
@@ -39,7 +44,9 @@ def default_collate(samples):
         )
     if isinstance(first, dict):
         return {k: default_collate([s[k] for s in samples]) for k in first}
-    return np.stack([np.asarray(s) for s in samples])
+    from .. import csrc
+
+    return csrc.fast_stack(samples)
 
 
 class DataLoader:

@@ -1,0 +1,146 @@
+"""Mixture-of-Experts with expert parallelism over the "ep" mesh axis.
+
+Capability extension beyond the reference (`SURVEY.md` §2.2: EP/MoE absent).
+TPU-native formulation = GShard/Switch dense dispatch: routing is expressed
+as einsums against one-hot dispatch/combine tensors (capacity-bounded), so
+the whole layer is MXU matmuls with static shapes — no scatter, no
+data-dependent shapes. Under pjit, sharding the stacked expert weights
+[E, ...] over "ep" while tokens ride "dp" makes XLA emit the canonical
+all-to-all dispatch/return pair on ICI; no hand-written collectives.
+
+``MOE_RULES`` (consumed by parallel/tensor.py's TensorParallel) shard the
+expert dim; combine with MEGATRON_RULES for tp x ep layouts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# PartitionSpec templates for TensorParallel(rules=...): expert dim -> "ep"
+MOE_RULES = (
+    (r"moe/w1$", ("ep", None, None)),
+    (r"moe/w2$", ("ep", None, None)),
+    (r"moe/b1$", ("ep", None)),
+    (r"moe/b2$", ("ep", None)),
+)
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.5
+    d_model: int = 64
+    d_ff: int = 256
+    aux_loss_weight: float = 0.01
+    dtype: jnp.dtype = jnp.float32
+
+
+def _top_k_routing(probs, k: int, capacity: int):
+    """probs [N, E] -> dispatch [N, E, C] bool-ish, combine [N, E, C].
+
+    Iterative top-k (k small): pick argmax, bank position-in-expert via
+    cumsum, mask, repeat. Tokens past capacity are dropped (their combine
+    weight is 0 — residual carries them, Switch-style).
+    """
+    n, e = probs.shape
+    remaining = probs
+    dispatch = jnp.zeros((n, e, capacity), probs.dtype)
+    combine = jnp.zeros((n, e, capacity), probs.dtype)
+    # track how many tokens each expert has accepted so far across k rounds
+    fill = jnp.zeros((e,), jnp.int32)
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)  # [N]
+        onehot = jax.nn.one_hot(idx, e, dtype=probs.dtype)  # [N, E]
+        pos = jnp.cumsum(onehot, axis=0) - 1 + fill[None, :]  # [N, E]
+        pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [N]
+        keep = pos_tok < capacity
+        gate = jnp.sum(probs * onehot, axis=-1) * keep  # [N]
+        slot = jax.nn.one_hot(
+            jnp.where(keep, pos_tok, capacity), capacity + 1, dtype=probs.dtype
+        )[:, :capacity]  # overflow -> all-zero row
+        dispatch = dispatch + onehot[:, :, None] * slot[:, None, :]
+        combine = combine + gate[:, None, None] * onehot[:, :, None] * slot[:, None, :]
+        fill = fill + jnp.sum(onehot * keep[:, None], axis=0).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)
+    return dispatch, combine
+
+
+def load_balance_loss(probs, dispatch):
+    """Switch-style aux loss: E * mean(frac_tokens_e) . mean(prob_e)."""
+    e = probs.shape[-1]
+    frac = jnp.mean(jnp.sum(dispatch, axis=-1), axis=0)  # [E] tokens routed
+    frac = frac / jnp.maximum(jnp.sum(frac), 1e-9)
+    mean_prob = jnp.mean(probs, axis=0)
+    return e * jnp.sum(frac * mean_prob)
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MLP replacement: returns (y, aux_loss)."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, t, d = x.shape
+        n = b * t
+        e = cfg.num_experts
+        capacity = max(1, int(cfg.capacity_factor * n * cfg.top_k / e))
+        tokens = x.reshape(n, d)
+
+        wg = self.param("router", nn.initializers.normal(0.02), (d, e))
+        probs = jax.nn.softmax(
+            (tokens @ wg.astype(x.dtype)).astype(jnp.float32), axis=-1
+        )
+        dispatch, combine = _top_k_routing(probs, cfg.top_k, capacity)
+        aux = load_balance_loss(probs, dispatch) * cfg.aux_loss_weight
+
+        scope = "moe"  # path anchor for MOE_RULES
+        init = nn.initializers.normal(0.02)
+        w1 = self.param(f"{scope}/w1", init, (e, d, cfg.d_ff))
+        b1 = self.param(f"{scope}/b1", nn.initializers.zeros, (e, cfg.d_ff))
+        w2 = self.param(f"{scope}/w2", init, (e, cfg.d_ff, d))
+        b2 = self.param(f"{scope}/b2", nn.initializers.zeros, (e, d))
+
+        dispatch = dispatch.astype(x.dtype)
+        combine = combine.astype(x.dtype)
+        # [N,E,C] x [N,D] -> [E,C,D]: the all-to-all boundary under ep
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, tokens)
+        h = jnp.einsum("ecd,edf->ecf", expert_in, w1.astype(x.dtype))
+        h = nn.gelu(h + b1[:, None, :].astype(x.dtype))
+        out = jnp.einsum("ecf,efd->ecd", h, w2.astype(x.dtype))
+        out = out + b2[:, None, :].astype(x.dtype)
+        # unused slots have zero combine weight, so their bias never leaks
+        y = jnp.einsum("nec,ecd->nd", combine, out)
+        return y.reshape(b, t, d), aux
+
+
+class MoEBlock(nn.Module):
+    """Pre-LN transformer block with an MoE MLP; returns (y, aux_loss)."""
+
+    cfg: MoEConfig
+    num_heads: int = 4
+
+    @nn.compact
+    def __call__(self, x, causal: bool = True):
+        from .gpt2 import default_attention
+
+        d = self.cfg.d_model
+        h = self.num_heads
+        y = nn.LayerNorm(dtype=self.cfg.dtype, name="ln_1")(x)
+        qkv = nn.Dense(3 * d, dtype=self.cfg.dtype, name="c_attn")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        rs = lambda a: a.reshape(*a.shape[:2], h, d // h)  # noqa: E731
+        y = default_attention(rs(q), rs(k), rs(v), causal=causal)
+        y = nn.Dense(d, dtype=self.cfg.dtype, name="c_proj")(
+            y.reshape(*y.shape[:2], d)
+        )
+        x = x + y
+        y = nn.LayerNorm(dtype=self.cfg.dtype, name="ln_2")(x)
+        y, aux = MoEMLP(self.cfg)(y)
+        return x + y, aux

@@ -1,5 +1,10 @@
-"""Ops layer: named collectives and Pallas kernels."""
+"""Ops layer: named collectives, SP attention, and Pallas kernels."""
 
+from .ring_attention import (
+    make_ring_attn_fn,
+    ring_attention,
+    ulysses_attention,
+)
 from .collectives import (
     all_reduce,
     all_gather,
@@ -32,4 +37,7 @@ __all__ = [
     "host_broadcast",
     "ring_shift",
     "tree_all_reduce",
+    "make_ring_attn_fn",
+    "ring_attention",
+    "ulysses_attention",
 ]

@@ -1,0 +1,137 @@
+"""Pallas TPU flash attention — the framework's hot-op custom kernel.
+
+The reference leans on cuDNN/ATen fused kernels for its hot ops (`SURVEY.md`
+§2.5 native checklist item 5); the TPU-native escape hatch is Pallas. This
+kernel computes blockwise attention with online softmax entirely in VMEM:
+one [bq, dh] query tile stays resident while K/V stream through in [bk, dh]
+tiles — O(T) HBM traffic instead of the O(T^2) logits round-trip, f32
+accumulators on the MXU (`/opt/skills/guides/pallas_guide.md` patterns).
+
+Forward runs the Pallas kernel; backward is a custom VJP that recomputes
+attention with XLA ops (flash-style recompute — no O(T^2) residuals saved).
+``make_flash_attn_fn`` returns a drop-in ``attn_fn`` for the model zoo and
+falls back to XLA attention off-TPU (CPU tests run ``interpret=True``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_BIG_NEG = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, causal, scale):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, dh]
+    t = k_ref.shape[2]
+    dh = q.shape[-1]
+    nk = t // bk
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(kpos <= qpos, s, _BIG_NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l = l * corr + jnp.sum(p, axis=1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l
+
+    acc0 = jnp.zeros((bq, dh), jnp.float32)
+    m0 = jnp.full((bq,), _BIG_NEG, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    # causal: blocks with j*bk > (qi+1)*bq - 1 are fully masked; skip them
+    nk_run = jnp.minimum(nk, (qi + 1) * bq // bk + 1) if causal else nk
+    acc, m, l = jax.lax.fori_loop(0, nk_run, body, (acc0, m0, l0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, causal, bq, bk, interpret):
+    b, t, h, dh = q.shape
+    bq = min(bq, t)
+    bk = min(bk, t)
+    if t % bq or t % bk:
+        raise ValueError(f"seq len {t} must divide block sizes ({bq},{bk})")
+    scale = 1.0 / (dh**0.5)
+    # [B, H, T, Dh] — contiguous K/V streams per (batch, head) program
+    qt, kt, vt = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+    grid = (b, h, t // bq)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, bq=bq, bk=bk, causal=causal, scale=scale
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, dh), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, t, dh), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, t, dh), lambda b_, h_, i: (b_, h_, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, dh), lambda b_, h_, i: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q, k, v, causal: bool = True, bq: int = 128, bk: int = 128,
+    interpret: bool = False,
+):
+    """Flash attention. q/k/v: [B, T, H, Dh] -> [B, T, H, Dh]."""
+    return _flash_forward(
+        q, k, v, causal=causal, bq=bq, bk=bk, interpret=interpret
+    )
+
+
+def _fwd(q, k, v, causal, bq, bk, interpret):
+    out = _flash_forward(
+        q, k, v, causal=causal, bq=bq, bk=bk, interpret=interpret
+    )
+    return out, (q, k, v)
+
+
+def _bwd(causal, bq, bk, interpret, res, g):
+    # flash-style recompute: re-derive attention with XLA ops and let AD
+    # produce the gradient — no O(T^2) residuals were materialized in fwd
+    from ..models.gpt2 import default_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: default_attention(a, b, c, causal=causal),
+                    q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def make_flash_attn_fn(*, bq: int = 128, bk: int = 128, interpret=None):
+    """Drop-in ``attn_fn`` for models/; XLA fallback off-TPU."""
+
+    def attn_fn(q, k, v, *, causal: bool = True):
+        interp = interpret
+        if interp is None:
+            interp = jax.devices()[0].platform != "tpu"
+        if interp and jax.devices()[0].platform not in ("cpu", "tpu"):
+            from ..models.gpt2 import default_attention
+
+            return default_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal, bq, bk, interp)
+
+    return attn_fn

@@ -1,0 +1,126 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the "pp" mesh axis.
+
+Not present in the reference (`SURVEY.md` §2.2: TP/PP/SP absent) — a
+TPU-native capability extension. Stages live on different devices along the
+"pp" axis; activations hop stage→stage over ICI via ``ppermute`` while M
+microbatches fill the pipe (GPipe schedule: M + N - 1 ticks, bubble
+fraction (N-1)/(M+N-1)). The whole schedule is ONE `lax.scan` inside ONE
+`shard_map` inside the jitted train step — XLA overlaps the ppermute with
+the next tick's stage compute; reverse-mode AD through the scan yields the
+backward pipeline automatically.
+
+Contract: every stage maps [mb, ...] -> [mb, ...] with the SAME shape
+(transformer blocks). Embed/head layers stay outside the pipeline
+(replicated or tp-sharded). Stage params are a single stacked pytree with
+leading dim = n_stages, sharded P("pp") — build it with
+:func:`stack_stage_params` or init with vmap.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stage_params(params_list):
+    """[tree_0, ..., tree_{n-1}] (same structure) -> stacked tree."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def unstack_stage_params(stacked):
+    n = jax.tree.leaves(stacked)[0].shape[0]
+    return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]
+
+
+def _batch_axes(mesh: Mesh) -> tuple:
+    return tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+
+
+def _gpipe_local(stage_params, x, *, stage_fn, n_micro, axis_name):
+    """Runs inside shard_map: one pp rank, local stage params [1, ...]."""
+    sparams = jax.tree.map(lambda a: a[0], stage_params)
+    n = jax.lax.psum(1, axis_name)
+    r = jax.lax.axis_index(axis_name)
+
+    b = x.shape[0]
+    micro = x.reshape(n_micro, b // n_micro, *x.shape[1:])
+    # promote to pp-varying so scan carries have a uniform vma type
+    micro = jax.lax.pvary(micro, (axis_name,))
+
+    state0 = micro[0] * 0
+    outs0 = micro * 0
+    send = [(i, i + 1) for i in range(n - 1)]  # stage r -> r+1
+
+    def tick(carry, t):
+        state, outs = carry
+        mt = jax.lax.dynamic_index_in_dim(
+            micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+        )
+        inp = jnp.where(r == 0, mt, state)
+        y = stage_fn(sparams, inp)
+        # last stage banks microbatch t-(n-1) once it emerges from the pipe
+        oi = t - (n - 1)
+        valid = jnp.logical_and(r == n - 1, oi >= 0)
+        banked = jax.lax.dynamic_update_index_in_dim(
+            outs, y, jnp.clip(oi, 0, n_micro - 1), 0
+        )
+        outs = jnp.where(valid, banked, outs)
+        state = jax.lax.ppermute(y, axis_name, send)
+        return (state, outs), None
+
+    (_, outs), _ = jax.lax.scan(
+        tick, (state0, outs0), jnp.arange(n_micro + n - 1)
+    )
+    # replicate the last stage's outputs across the pp axis
+    outs = jax.lax.psum(
+        jnp.where(r == n - 1, outs, outs * 0), axis_name
+    )
+    return outs.reshape(b, *x.shape[1:])
+
+
+def pipeline_apply(
+    stage_params,
+    x,
+    *,
+    stage_fn: Callable,
+    mesh: Mesh,
+    n_micro: int,
+    axis_name: str = "pp",
+):
+    """Apply n_stages pipelined stages to x [B, ...] -> [B, ...].
+
+    ``stage_params``: stacked tree, leading dim n_stages (= pp axis size).
+    ``stage_fn(params_one_stage, x_micro) -> y_micro``, shape-preserving.
+    """
+    n_stages = mesh.shape.get(axis_name, 1)
+    if n_stages <= 1:
+        # degenerate pipe: run stages sequentially on one device
+        out = x
+        for p in unstack_stage_params(stage_params):
+            out = stage_fn(p, out)
+        return out
+    batch = _batch_axes(mesh)
+    dp_total = 1
+    for a in batch:
+        dp_total *= mesh.shape[a]
+    local_b, rem = divmod(x.shape[0], dp_total)
+    if rem or local_b % n_micro:
+        raise ValueError(
+            f"per-shard batch {x.shape[0]}/{dp_total} not divisible by "
+            f"n_micro {n_micro} (microbatching is per data-parallel shard)"
+        )
+    pspec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    xspec = P(batch or None, *([None] * (x.ndim - 1)))
+    return jax.shard_map(
+        partial(
+            _gpipe_local, stage_fn=stage_fn, n_micro=n_micro,
+            axis_name=axis_name,
+        ),
+        mesh=mesh,
+        in_specs=(pspec, xspec),
+        out_specs=xspec,
+    )(stage_params, x)

@@ -1,0 +1,127 @@
+"""Tensor parallelism: path-aware Megatron-style sharding rules.
+
+The reference has no TP (`SURVEY.md` §2.2 last row) — this is a TPU-native
+capability extension. In the pjit world a TP "engine" is not a wrapper class
+with manual collectives: it is a set of **rules mapping parameter paths to
+PartitionSpecs** over the "tp" mesh axis. XLA's SPMD partitioner then emits
+the canonical Megatron pattern (column-parallel QKV/MLP-in, row-parallel
+proj/MLP-out, one all-reduce after attention and one after the MLP) from
+the param layout alone — correctness is sharding-independent, so every rule
+here is purely a performance statement.
+
+Rules compose with the ZeRO family (`parallel/policy.py`): after the TP rule
+claims a dim, the ZeRO axis shards the largest remaining dim — the classic
+2D (tp × fsdp) layout used for large LMs.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .policy import Policy
+from .spec import shard_axis
+
+# (regex over "a/b/c" param path, spec template per dim). First match wins.
+# Matches the naming used across models/ (gpt2, vit, swinir attention).
+MEGATRON_RULES = (
+    # column-parallel: shard the output features of QKV and MLP-in
+    (r"(c_attn|mlp_fc|qkv)/kernel$", (None, "tp")),
+    (r"(c_attn|mlp_fc|qkv)/bias$", ("tp",)),
+    # row-parallel: shard the input features of the output projections
+    (r"(c_proj|mlp_proj|proj)/kernel$", ("tp", None)),
+    # vocab-parallel embedding + LM head
+    (r"wte$", ("tp", None)),
+    (r"(head|lm_head)/kernel$", (None, "tp")),
+)
+
+
+def path_str(path) -> str:
+    """KeyPath -> "h_0/c_attn/kernel"-style string."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:  # pragma: no cover - future key types
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+@dataclass(frozen=True)
+class TensorParallel(Policy):
+    """TP rules + optional ZeRO flags (inherited) = 2D tp × fsdp sharding.
+
+    ``TensorParallel()`` alone is TP + DDP (params replicated over dp, split
+    over tp); pass ``shard_params=True`` etc. (or use :func:`tp_zero3`) for
+    the 2D layout. Templates name mesh axes verbatim, so rule sets over
+    different axes compose: ``rules=MEGATRON_RULES + MOE_RULES`` shards
+    attention/MLP over "tp" AND expert banks over "ep" in one policy.
+    """
+
+    rules: tuple = MEGATRON_RULES
+
+    def _leaf(self, path, leaf, mesh: Mesh, shard_zero: bool) -> P:
+        shape = tuple(leaf.shape)
+        spec = [None] * len(shape)
+        p = path_str(path)
+        for pat, tmpl in self.rules:
+            if re.search(pat, p):
+                if len(tmpl) == len(shape):
+                    # per-dim backoff: keep a template axis only when it is
+                    # sized on this mesh and divides the dim
+                    spec = [
+                        a
+                        if a is not None
+                        and mesh.shape.get(a, 1) > 1
+                        and shape[i] % mesh.shape[a] == 0
+                        else None
+                        for i, a in enumerate(tmpl)
+                    ]
+                break
+        zax = shard_axis(mesh)
+        if shard_zero and zax is not None and zax not in spec:
+            zsize = mesh.shape[zax]
+            if int(np.prod(shape, dtype=np.int64)) >= self.min_shard_size:
+                free = [
+                    i for i, a in enumerate(spec)
+                    if a is None and shape[i] % zsize == 0 and shape[i] > 0
+                ]
+                if free:
+                    dim = max(free, key=lambda i: shape[i])
+                    spec[dim] = zax
+        return P(*spec)
+
+    def _tree(self, tree, mesh: Mesh, shard_zero: bool):
+        return jax.tree_util.tree_map_with_path(
+            lambda p, x: self._leaf(p, x, mesh, shard_zero), tree
+        )
+
+    def params_specs(self, params, mesh: Mesh):
+        return self._tree(params, mesh, self.shard_params)
+
+    def opt_specs(self, opt_state, mesh: Mesh):
+        return self._tree(opt_state, mesh, self.shard_opt_state)
+
+    def grads_specs(self, params, mesh: Mesh):
+        if not self.shard_grads:
+            return None  # TP grads inherit layout from params; XLA infers
+        return self._tree(params, mesh, True)
+
+
+def tp_zero3(**kw) -> TensorParallel:
+    """The 2D large-LM layout: tp rules + fully-sharded dp state."""
+    return TensorParallel(
+        shard_params=True, shard_opt_state=True, shard_grads=True, **kw
+    )
+
+
+def tp_zero1(**kw) -> TensorParallel:
+    return TensorParallel(shard_opt_state=True, **kw)

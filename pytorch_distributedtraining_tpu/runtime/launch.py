@@ -1,0 +1,158 @@
+"""Launcher shims: twins of ``torch.distributed.launch`` and ``mp.spawn``.
+
+The reference starts ranks two ways (SURVEY §1/L6):
+
+- ``python -m torch.distributed.launch --nproc_per_node=4 Stoke-DDP.py``
+  (`/root/reference/Stoke-DDP.py:1-2`; impl `torch/distributed/launch.py:201`)
+- ``mp.spawn(train, args=(W, E), nprocs=4)``
+  (`/root/reference/Fairscale-DDP.py:125-133`;
+  `torch/multiprocessing/spawn.py:300`)
+
+On a TPU pod the natural unit is one process per HOST (each driving all its
+local chips), so the launcher's job is host-level fan-out plus the env
+contract (`RANK`/`LOCAL_RANK`/`WORLD_SIZE`/`MASTER_*`) that
+`runtime/dist.initialize` consumes. Both shims also run multi-process on one
+CPU host — the reference's localhost-testing trick — by giving each child
+one virtual CPU device.
+
+CLI:  python -m pytorch_distributedtraining_tpu.runtime.launch \
+          --nproc_per_node=4 your_script.py --its --flags
+"""
+
+from __future__ import annotations
+
+import argparse
+import multiprocessing
+import os
+import subprocess
+import sys
+
+from .dist import find_free_port
+
+
+def _child_env(
+    rank: int, local_rank: int, world_size: int, master_addr: str,
+    master_port: int, one_cpu_device: bool,
+) -> dict:
+    env = dict(os.environ)
+    env.update(
+        RANK=str(rank),
+        LOCAL_RANK=str(local_rank),
+        WORLD_SIZE=str(world_size),
+        MASTER_ADDR=master_addr,
+        MASTER_PORT=str(master_port),
+    )
+    if one_cpu_device:
+        # localhost testing: each rank gets its own single-device CPU
+        # backend (the gloo-on-localhost analogue, Fairscale-DDP.py:27).
+        # Children must NOT attach to a real accelerator — N ranks
+        # fighting over one chip deadlocks — so drop the TPU/plugin
+        # attach vars alongside forcing the cpu platform.
+        env["JAX_PLATFORMS"] = "cpu"
+        for k in list(env):
+            if k.startswith(("TPU_", "PALLAS_AXON_", "AXON_")) or k in (
+                "COORDINATOR_ADDRESS",
+                "MEGASCALE_COORDINATOR_ADDRESS",
+            ):
+                env.pop(k)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p
+        )
+        env.setdefault("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in env["XLA_FLAGS"]:
+            env["XLA_FLAGS"] = (
+                env["XLA_FLAGS"] + " --xla_force_host_platform_device_count=1"
+            ).strip()
+    return env
+
+
+def _spawn_target(fn, rank, args, env):
+    # replace, don't merge: _child_env REMOVES accelerator-attach vars, and
+    # update() alone would leave them inherited from the parent
+    os.environ.clear()
+    os.environ.update(env)
+    fn(rank, *args)
+
+
+def spawn(
+    fn,
+    args: tuple = (),
+    nprocs: int = 1,
+    *,
+    join: bool = True,
+    master_addr: str = "127.0.0.1",
+    master_port: int | None = None,
+    one_cpu_device: bool = True,
+):
+    """``mp.spawn`` twin: run ``fn(rank, *args)`` in ``nprocs`` processes.
+
+    Sets the env rendezvous contract for each child so ``fn`` can call
+    ``runtime.dist.initialize()`` exactly like the reference's ``train``
+    calls ``init_process_group`` (`Fairscale-DDP.py:20-27`).
+    """
+    master_port = master_port or find_free_port()
+    ctx = multiprocessing.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = _child_env(
+            rank, rank, nprocs, master_addr, master_port, one_cpu_device
+        )
+        p = ctx.Process(target=_spawn_target, args=(fn, rank, args, env))
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    failed = []
+    for rank, p in enumerate(procs):
+        p.join()
+        if p.exitcode != 0:
+            failed.append((rank, p.exitcode))
+    if failed:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        raise RuntimeError(f"spawned ranks failed: {failed}")
+    return None
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="TPU-native torch.distributed.launch twin"
+    )
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--master_addr", default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=None)
+    parser.add_argument(
+        "--one_cpu_device_per_rank", action="store_true",
+        help="give each rank a single virtual CPU device (localhost testing)",
+    )
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    opt = parser.parse_args(argv)
+
+    world = opt.nnodes * opt.nproc_per_node
+    port = opt.master_port or find_free_port()
+    procs = []
+    for local_rank in range(opt.nproc_per_node):
+        rank = opt.node_rank * opt.nproc_per_node + local_rank
+        env = _child_env(
+            rank, local_rank, world, opt.master_addr, port,
+            opt.one_cpu_device_per_rank,
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, opt.script, *opt.script_args], env=env
+            )
+        )
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""Native fastpipe host kernels vs numpy reference."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributedtraining_tpu import csrc
+
+
+def test_builds_and_loads():
+    # g++ is baked into this image; the extension must actually build
+    assert csrc.available()
+
+
+def test_fast_stack_matches_numpy():
+    rng = np.random.default_rng(0)
+    arrays = [rng.normal(size=(64, 64, 3)).astype(np.float32) for _ in range(16)]
+    out = csrc.fast_stack(arrays)
+    np.testing.assert_array_equal(out, np.stack(arrays))
+    assert out.flags["C_CONTIGUOUS"]
+
+
+def test_fast_stack_u8():
+    rng = np.random.default_rng(1)
+    arrays = [
+        rng.integers(0, 255, size=(128, 128, 3), dtype=np.uint8)
+        for _ in range(8)
+    ]
+    np.testing.assert_array_equal(csrc.fast_stack(arrays), np.stack(arrays))
+
+
+def test_fast_stack_small_or_mixed_falls_back():
+    # tiny leaves and scalar labels take the numpy path but still work
+    out = csrc.fast_stack([np.int64(3), np.int64(5)])
+    np.testing.assert_array_equal(out, [3, 5])
+
+
+def test_normalize_u8_matches_numpy():
+    rng = np.random.default_rng(2)
+    batch = rng.integers(0, 255, size=(4, 32, 32, 3), dtype=np.uint8)
+    mean, std = (0.485, 0.456, 0.406), (0.229, 0.224, 0.225)
+    out = csrc.normalize_u8(batch, mean, std)
+    ref = (batch.astype(np.float32) / 255.0 - np.float32(mean)) / np.float32(std)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+    assert out.dtype == np.float32
+
+
+def test_normalize_scalar_mean_std():
+    batch = np.full((2, 4, 4, 1), 128, np.uint8)
+    out = csrc.normalize_u8(batch, mean=0.5, std=0.5)
+    np.testing.assert_allclose(out, (128 / 255 - 0.5) / 0.5, atol=1e-6)
+
+
+def test_normalize_bad_channels_raises():
+    with pytest.raises(ValueError, match="channels"):
+        csrc.normalize_u8(np.zeros((2, 2, 2, 4), np.uint8), (0.5,) * 3, (0.5,) * 3)
+
+
+def test_collate_uses_fastpipe():
+    from pytorch_distributedtraining_tpu.data.loader import default_collate
+
+    rng = np.random.default_rng(3)
+    samples = [
+        (rng.normal(size=(32, 32, 3)).astype(np.float32), np.int64(i))
+        for i in range(8)
+    ]
+    imgs, labels = default_collate(samples)
+    assert imgs.shape == (8, 32, 32, 3)
+    np.testing.assert_array_equal(labels, np.arange(8))
+    np.testing.assert_array_equal(imgs[3], samples[3][0])
+
+
+def test_fast_stack_strided_crops():
+    """Crops of decoded images stack without intermediate copies."""
+    rng = np.random.default_rng(4)
+    images = [
+        rng.integers(0, 255, size=(96, 96, 3), dtype=np.uint8)
+        for _ in range(6)
+    ]
+    crops = [img[10:74, 20:84, :] for img in images]  # 64x64 crops, strided
+    out = csrc.fast_stack_strided(crops)
+    np.testing.assert_array_equal(out, np.stack(crops))
+    assert out.shape == (6, 64, 64, 3)
+
+
+def test_fast_stack_strided_mixed_pitch_falls_back():
+    a = np.zeros((100, 8), np.float32)[10:20]
+    b = np.zeros((50, 8), np.float32)[::2][:10]  # different pitch
+    out = csrc.fast_stack_strided([a, b])
+    np.testing.assert_array_equal(out, np.stack([a, b]))

@@ -1,0 +1,53 @@
+"""Launcher shim: real multi-process rendezvous on localhost (2 ranks).
+
+End-to-end twin of the reference's own integration test — mp.spawn over
+gloo ranks on 127.0.0.1 (`/root/reference/Fairscale-DDP.py:112-133`): here
+the launch CLI forks 2 python processes, each with a single virtual CPU
+device, which rendezvous through `runtime.dist.initialize` (env contract)
+and run a cross-process allgather.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = """
+import os
+import jax
+from pytorch_distributedtraining_tpu.runtime import dist
+
+dist.initialize()
+assert jax.process_count() == int(os.environ["WORLD_SIZE"]), jax.process_count()
+
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+
+ranks = multihost_utils.process_allgather(jnp.array([jax.process_index()]))
+assert sorted(int(r) for r in ranks.ravel()) == [0, 1], ranks
+
+open(os.environ["MARKER"] + os.environ["RANK"], "w").write("ok")
+"""
+
+
+def test_launch_cli_two_ranks(tmp_path):
+    script = tmp_path / "child.py"
+    script.write_text(CHILD)
+    marker = str(tmp_path / "done_")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MARKER"] = marker
+    env.pop("JAX_PLATFORMS", None)  # children set their own backend env
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "pytorch_distributedtraining_tpu.runtime.launch",
+            "--nproc_per_node=2", "--one_cpu_device_per_rank",
+            str(script),
+        ],
+        env=env, capture_output=True, text=True, timeout=240, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert os.path.exists(marker + "0") and os.path.exists(marker + "1")
