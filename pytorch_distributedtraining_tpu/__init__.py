@@ -61,8 +61,11 @@ _LAZY = {
     "optim": ".optim",
     "precision": ".precision",
     "checkpoint": ".checkpoint",
+    "checkpoint_sharded": ".checkpoint_sharded",
+    "CheckpointManager": ".checkpoint_sharded",
+    "interop": ".interop",
+    "csrc": ".csrc",
     "observe": ".observe",
-    "utils": ".utils",
 }
 
 

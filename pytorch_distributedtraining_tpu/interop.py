@@ -1,0 +1,179 @@
+"""Torch checkpoint interop: read ``.pth`` files into framework pytrees.
+
+The reference loads a pretrained torch checkpoint nested under a
+``'params'`` key with ``strict=True``
+(`/root/reference/Stoke-DDP.py:209-213`:
+``torch.load(...)['params']`` → ``load_state_dict(strict=True)``). A user
+migrating from the reference holds exactly such files, so the framework
+reads them natively: :func:`load_torch_checkpoint` produces a nested numpy
+dict that feeds ``checkpoint.load_params_dict`` (the strict loader twin).
+
+Layout conversion (torch OIHW / [out,in] → flax HWIO / [in,out]) is
+mechanical and driven by the target template via
+:func:`convert_torch_tensors`; name mapping between arbitrary torch and
+flax module trees is model-specific and supplied by the caller as a
+key-rewrite table.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .checkpoint import flat_dict_to_tree
+
+
+def load_torch_checkpoint(path: str) -> dict:
+    """torch.load a ``.pth``/``.pt`` file → nested dict of numpy arrays.
+
+    Accepts the formats the reference uses: a flat ``state_dict`` (dotted
+    torch keys become nesting) or a wrapper dict (e.g. ``{'params': ...}``,
+    `Stoke-DDP.py:209-211`) whose nesting is preserved.
+    """
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    return _to_numpy_tree(obj)
+
+
+def _to_numpy_tree(obj):
+    import torch
+
+    if isinstance(obj, torch.Tensor):
+        return obj.detach().cpu().numpy()
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            v = _to_numpy_tree(v)
+            if isinstance(k, str) and "." in k:
+                # dotted state_dict key -> nested path
+                node = out
+                parts = k.split(".")
+                for p in parts[:-1]:
+                    node = node.setdefault(p, {})
+                node[parts[-1]] = v
+            else:
+                out[k] = v
+        return out
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy_tree(v) for v in obj)
+    return obj
+
+
+def torch_to_flax_array(name: str, a: np.ndarray, target_shape) -> np.ndarray:
+    """Convert one torch tensor to the flax layout ``target_shape`` expects.
+
+    - Conv kernel  OIHW -> HWIO           (torch [O,I,kh,kw])
+    - Linear kernel [out,in] -> [in,out]
+    - everything else passes through (biases, norms, embeddings)
+    """
+    target_shape = tuple(target_shape)
+    if a.shape == target_shape:
+        return a
+    if a.ndim == 4 and tuple(np.transpose(a, (2, 3, 1, 0)).shape) == target_shape:
+        return np.transpose(a, (2, 3, 1, 0))  # OIHW -> HWIO
+    if a.ndim == 2 and a.T.shape == target_shape:
+        return a.T  # [out,in] -> [in,out]
+    raise ValueError(
+        f"cannot map torch tensor {name} of shape {a.shape} onto {target_shape}"
+    )
+
+
+def convert_torch_tensors(flat_torch: dict, flat_template: dict) -> dict:
+    """Layout-convert every torch leaf to its same-key template leaf."""
+    out = {}
+    for k, v in flat_torch.items():
+        if k in flat_template:
+            out[k] = torch_to_flax_array(k, v, np.shape(flat_template[k]))
+        else:
+            out[k] = v
+    return out
+
+
+def rewrite_keys(flat: dict, table: list[tuple[str, str]]) -> dict:
+    """Apply ``(regex, replacement)`` rewrites to flat ``a/b/c`` keys."""
+    import re
+
+    out = {}
+    for k, v in flat.items():
+        for pat, repl in table:
+            k = re.sub(pat, repl, k)
+        out[k] = v
+    return out
+
+
+def default_torch_key_map(flat_torch: dict, flat_template: dict) -> dict:
+    """Heuristic torch→flax key renames for matching module trees.
+
+    For each torch key ending in ``weight``/``running_mean``/``running_var``,
+    pick the template twin (``kernel`` for conv/linear, ``scale`` for norms,
+    ``mean``/``var`` for BN stats) when that key exists. Names of the module
+    path itself must already correspond (supply a ``rewrite_keys`` table when
+    they don't).
+    """
+    mapping = {}
+    candidates = {
+        "weight": ("kernel", "scale", "embedding"),
+        "running_mean": ("mean",),
+        "running_var": ("var",),
+    }
+    for k in flat_torch:
+        head, _, leaf = k.rpartition("/")
+        for suffix, repls in candidates.items():
+            if leaf == suffix:
+                for r in repls:
+                    cand = f"{head}/{r}" if head else r
+                    if cand in flat_template:
+                        mapping[k] = cand
+                        break
+    return mapping
+
+
+def load_torch_into_template(
+    source: dict,
+    template,
+    *,
+    key_map: dict | list | None = None,
+    strict: bool = True,
+    param_key: str = "params",
+):
+    """Full torch→flax load: nesting, key renames, layout conversion.
+
+    ``source``: output of :func:`load_torch_checkpoint` (or any nested
+    numpy dict, optionally under ``param_key``). ``key_map``: either an
+    explicit ``{torch_flat_key: flax_flat_key}`` dict or a
+    ``[(regex, repl), ...]`` rewrite table; the :func:`default_torch_key_map`
+    heuristic is applied afterwards for weight/kernel/scale twins.
+    Returns a params tree matching ``template``.
+    """
+    from .checkpoint import load_params_dict, tree_to_flat_dict
+
+    src = source[param_key] if isinstance(source, dict) and param_key in source else source
+    flat_src = tree_to_flat_dict(src)
+    flat_tpl = tree_to_flat_dict(template)
+    if isinstance(key_map, (list, tuple)):
+        flat_src = rewrite_keys(flat_src, list(key_map))
+        key_map = None
+    if key_map:
+        flat_src = {key_map.get(k, k): v for k, v in flat_src.items()}
+    auto = default_torch_key_map(flat_src, flat_tpl)
+    flat_src = {auto.get(k, k): v for k, v in flat_src.items()}
+    flat_src = convert_torch_tensors(flat_src, flat_tpl)
+    return load_params_dict(
+        flat_dict_to_tree(flat_src), template, strict=strict,
+        param_key=param_key,
+    )
+
+
+def save_torch_checkpoint(path: str, tree: dict) -> None:
+    """Write a framework pytree as a torch-loadable ``.pth`` (reverse path:
+    lets reference users consume checkpoints trained here)."""
+    import torch
+
+    def to_torch(obj):
+        if isinstance(obj, dict):
+            return {k: to_torch(v) for k, v in obj.items()}
+        # copy=True: jax arrays surface as read-only numpy views, which
+        # torch.from_numpy would alias with a warning
+        return torch.from_numpy(np.array(obj, copy=True))
+
+    torch.save(to_torch(tree), path)

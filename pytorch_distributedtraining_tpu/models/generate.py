@@ -1,0 +1,102 @@
+"""Autoregressive generation: KV-cache prefill + jitted sampling loop.
+
+Inference capability beyond the reference's training-only surface: chunked
+prompt prefill into the Block KV caches (models/gpt2.py ``decode=True``),
+then one `lax.scan` over single-token steps — the whole decode loop is one
+compiled XLA program, cache updates are in-place dynamic slices, and
+sampling (greedy / temperature / top-k) is branchless.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(logits, rng, *, temperature: float = 1.0,
+                  top_k: Optional[int] = None):
+    """[B, V] logits -> [B] token ids. temperature=0 → greedy."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def init_cache(model, batch_size: int, max_len: int):
+    """Allocate the KV cache for ``batch_size`` x ``max_len`` decoding.
+
+    Shapes come from ``eval_shape`` over ``model.init`` — no params are
+    materialized and no forward pass runs; only the zero cache buffers are
+    allocated.
+    """
+    shapes = jax.eval_shape(
+        model.init,
+        jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((batch_size, max_len), jnp.int32),
+    )
+    return jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"]
+    )
+
+
+def generate(
+    model,
+    params,
+    prompt: jnp.ndarray,  # [B, T_prompt] int32
+    max_new_tokens: int,
+    *,
+    rng=None,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+):
+    """Returns [B, T_prompt + max_new_tokens] tokens (prompt included).
+
+    ``model`` must be constructed with ``decode=True``; its ``n_positions``
+    bounds the total length.
+    """
+    if not model.decode:
+        raise ValueError("generate() needs a model built with decode=True")
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    b, t_prompt = prompt.shape
+    total = t_prompt + max_new_tokens
+    if total > model.cfg.n_positions:
+        raise ValueError(
+            f"prompt+new = {total} exceeds n_positions {model.cfg.n_positions}"
+        )
+
+    cache = init_cache(model, b, total)
+
+    # chunked prefill: one pass over the whole prompt fills every KV cache
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, prompt, mutable=["cache"]
+    )
+    cache = mutated["cache"]
+    rng, sub = jax.random.split(rng)
+    next_tok = sample_logits(
+        logits[:, -1], sub, temperature=temperature, top_k=top_k
+    )
+
+    def step(carry, step_rng):
+        cache, tok = carry
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            mutable=["cache"],
+        )
+        nxt = sample_logits(
+            logits[:, -1], step_rng, temperature=temperature, top_k=top_k
+        )
+        return (mutated["cache"], nxt), tok
+
+    # max_new_tokens - 1 steps: the prefill already sampled token #1, and
+    # each step both banks its input token and samples the next
+    keys = jax.random.split(rng, max_new_tokens - 1)
+    (_, last), toks = jax.lax.scan(step, (cache, next_tok), keys)
+    generated = jnp.concatenate(
+        [toks.T.reshape(b, -1), last[:, None]], axis=1
+    )
+    return jnp.concatenate([prompt, generated.astype(prompt.dtype)], axis=1)

@@ -66,13 +66,43 @@ def default_attention(q, k, v, *, causal: bool = True):
 
 
 class Block(nn.Module):
-    """Pre-LN transformer block: LN → attn → +res, LN → MLP → +res."""
+    """Pre-LN transformer block: LN → attn → +res, LN → MLP → +res.
+
+    ``decode=True`` switches attention to incremental KV-cache mode: K/V
+    land in a ``"cache"`` collection sized by the init-time sequence length,
+    and each call attends the new queries against everything cached so far
+    (chunked prefill and single-token decode both work).
+    """
 
     cfg: GPT2Config
     attn_fn: AttnFn = default_attention
+    decode: bool = False
+
+    def _cached_attention(self, q, k, v, idx):
+        """[B, T, H, Dh] step against the persistent cache; ``idx`` is the
+        global write position (GPT2's single top-level counter)."""
+        is_initialized = self.has_variable("cache", "cached_key")
+        ck = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+        if not is_initialized:  # init pass defines cache shapes only
+            return default_attention(q, k, v, causal=True)
+        t = q.shape[1]
+        max_len = ck.value.shape[1]
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+        dh = q.shape[-1]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) / jnp.sqrt(
+            dh
+        ).astype(q.dtype)
+        qpos = idx + jnp.arange(t)[:, None]  # [T, 1] global positions
+        kpos = jnp.arange(max_len)[None, :]
+        mask = kpos <= qpos  # causal incl. everything already cached
+        logits = jnp.where(mask[None, None], logits, jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True, start_index=None):
         cfg = self.cfg
         d, h = cfg.n_embd, cfg.n_head
         dense = lambda feat, name: nn.Dense(  # noqa: E731
@@ -84,7 +114,13 @@ class Block(nn.Module):
         qkv = dense(3 * d, "c_attn")(y)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         reshape = lambda a: a.reshape(*a.shape[:2], h, d // h)  # noqa: E731
-        y = self.attn_fn(reshape(q), reshape(k), reshape(v), causal=True)
+        if self.decode:
+            y = self._cached_attention(
+                reshape(q), reshape(k), reshape(v),
+                jnp.zeros((), jnp.int32) if start_index is None else start_index,
+            )
+        else:
+            y = self.attn_fn(reshape(q), reshape(k), reshape(v), causal=True)
         y = y.reshape(*y.shape[:2], d)
         y = dense(d, "c_proj")(y)
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
@@ -99,10 +135,16 @@ class Block(nn.Module):
 
 
 class GPT2(nn.Module):
-    """GPT-2 LM. ``__call__(tokens [B, T]) -> logits [B, T, vocab]``."""
+    """GPT-2 LM. ``__call__(tokens [B, T]) -> logits [B, T, vocab]``.
+
+    ``decode=True``: incremental KV-cache inference — init with the max
+    sequence length to size the cache, then apply token chunks with
+    ``mutable=["cache"]`` (see models/generate.py).
+    """
 
     cfg: GPT2Config = GPT2Config()
     attn_fn: AttnFn = default_attention
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, deterministic: bool = True):
@@ -114,14 +156,29 @@ class GPT2(nn.Module):
         wpe = self.param(
             "wpe", nn.initializers.normal(0.01), (cfg.n_positions, cfg.n_embd)
         )
-        x = wte[tokens].astype(cfg.dtype) + wpe[:t].astype(cfg.dtype)
+        if self.decode and self.has_variable("cache", "position"):
+            pos_var = self.variable(
+                "cache", "position", lambda: jnp.zeros((), jnp.int32)
+            )
+            pos = pos_var.value + jnp.arange(t)
+            pos_var.value = pos_var.value + t
+            pe = wpe[pos]
+        else:
+            if self.decode:  # init pass: create the position counter
+                self.variable(
+                    "cache", "position", lambda: jnp.zeros((), jnp.int32)
+                )
+            pe = wpe[:t]
+        x = wte[tokens].astype(cfg.dtype) + pe.astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
         block_cls = Block
         if cfg.remat:
             block_cls = nn.remat(Block, static_argnums=(2,))  # (self, x, det)
         for i in range(cfg.n_layer):
-            x = block_cls(cfg, self.attn_fn, name=f"h_{i}")(x, deterministic)
+            x = block_cls(cfg, self.attn_fn, self.decode, name=f"h_{i}")(
+                x, deterministic
+            )
 
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         if cfg.tie_word_embeddings:

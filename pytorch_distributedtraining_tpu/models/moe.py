@@ -22,10 +22,10 @@ import jax.numpy as jnp
 
 # PartitionSpec templates for TensorParallel(rules=...): expert dim -> "ep"
 MOE_RULES = (
-    (r"moe/w1$", ("ep", None, None)),
-    (r"moe/w2$", ("ep", None, None)),
-    (r"moe/b1$", ("ep", None)),
-    (r"moe/b2$", ("ep", None)),
+    (r"expert_w1$", ("ep", None, None)),
+    (r"expert_w2$", ("ep", None, None)),
+    (r"expert_b1$", ("ep", None)),
+    (r"expert_b2$", ("ep", None)),
 )
 
 
@@ -100,12 +100,12 @@ class MoEMLP(nn.Module):
         dispatch, combine = _top_k_routing(probs, cfg.top_k, capacity)
         aux = load_balance_loss(probs, dispatch) * cfg.aux_loss_weight
 
-        scope = "moe"  # path anchor for MOE_RULES
+        # flat names (no "/": it is the checkpoint flat-key separator)
         init = nn.initializers.normal(0.02)
-        w1 = self.param(f"{scope}/w1", init, (e, d, cfg.d_ff))
-        b1 = self.param(f"{scope}/b1", nn.initializers.zeros, (e, cfg.d_ff))
-        w2 = self.param(f"{scope}/w2", init, (e, cfg.d_ff, d))
-        b2 = self.param(f"{scope}/b2", nn.initializers.zeros, (e, d))
+        w1 = self.param("expert_w1", init, (e, d, cfg.d_ff))
+        b1 = self.param("expert_b1", nn.initializers.zeros, (e, cfg.d_ff))
+        w2 = self.param("expert_w2", init, (e, cfg.d_ff, d))
+        b2 = self.param("expert_b2", nn.initializers.zeros, (e, d))
 
         dispatch = dispatch.astype(x.dtype)
         combine = combine.astype(x.dtype)

@@ -147,10 +147,28 @@ def main(argv=None) -> int:
                 [sys.executable, opt.script, *opt.script_args], env=env
             )
         )
+    # poll, don't wait sequentially: a crashed rank strands the others in
+    # the rendezvous/collective, so kill the survivors and report (the same
+    # fate-sharing torch.distributed.launch provides)
+    import time as _time
+
     code = 0
-    for p in procs:
-        p.wait()
-        code = code or p.returncode
+    try:
+        while procs:
+            for p in list(procs):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                procs.remove(p)
+                if rc != 0:
+                    code = code or rc
+                    for q in procs:
+                        q.terminate()
+            _time.sleep(0.1)
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
     return code
 
 

@@ -561,11 +561,33 @@ class Stoke:
         if self.verbose:
             self.print_on_devices(f"restored checkpoint @ step {int(self._state.step)}")
 
-    def load_model_state(self, source, strict: bool = True, param_key: str = "params"):
+    def load_model_state(
+        self, source, strict: bool = True, param_key: str = "params",
+        key_map=None,
+    ):
         """Pretrained-weights load with optional ``'params'`` nesting and
-        strict matching (`Stoke-DDP.py:209-213`)."""
+        strict matching (`Stoke-DDP.py:209-213`). Accepts framework ``.npz``
+        checkpoints or torch ``.pth``/``.pt`` files (the reference's
+        pretrained format): torch tensors get layout conversion (OIHW→HWIO,
+        [out,in]→[in,out]) and weight→kernel/scale renames automatically;
+        pass ``key_map`` (dict or ``[(regex, repl)]``) when the module paths
+        themselves differ (see interop.load_torch_into_template)."""
         self._require_state()
         if isinstance(source, str):
+            if source.endswith((".pth", ".pt")):
+                from ..interop import (
+                    load_torch_checkpoint,
+                    load_torch_into_template,
+                )
+
+                params = load_torch_into_template(
+                    load_torch_checkpoint(source),
+                    jax.device_get(self._state.params),
+                    key_map=key_map, strict=strict, param_key=param_key,
+                )
+                params = jax.device_put(params, self._shardings.params)
+                self._state = self._state.replace(params=params)
+                return
             flat, _ = ckpt.load_checkpoint(source)
             source = ckpt.flat_dict_to_tree(flat)
         params = ckpt.load_params_dict(
@@ -574,6 +596,26 @@ class Stoke:
         )
         params = jax.device_put(params, self._shardings.params)
         self._state = self._state.replace(params=params)
+
+    def save_sharded(self, path: str) -> str:
+        """Per-shard (orbax) save of the FULL train state — the TPU-scale
+        path: every process writes its own shards, no consolidation OOM."""
+        self._require_state()
+        from ..checkpoint_sharded import save_sharded as _save
+
+        return _save(path, self._state, force=True)
+
+    def load_sharded(self, path: str) -> None:
+        """Restore a :meth:`save_sharded` checkpoint into the live state,
+        preserving the policy's shardings."""
+        self._require_state()
+        from ..checkpoint_sharded import restore_sharded as _restore
+
+        self._state = _restore(path, self._state)
+        if self.verbose:
+            self.print_on_devices(
+                f"restored sharded checkpoint @ step {int(self._state.step)}"
+            )
 
     # -- introspection / rank I/O ------------------------------------------
 

@@ -1,0 +1,108 @@
+"""KV-cache decode vs full recompute; sampling behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributedtraining_tpu.models import GPT2, GPT2Config
+from pytorch_distributedtraining_tpu.models.generate import (
+    generate,
+    init_cache,
+    sample_logits,
+)
+
+CFG = GPT2Config.tiny(n_embd=32, n_head=4, n_positions=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = GPT2(CFG)
+    tok = jnp.zeros((1, 8), jnp.int32)
+    return model.init(jax.random.PRNGKey(0), tok)["params"]
+
+
+class TestKVCache:
+    def test_incremental_matches_full(self, params):
+        """Token-by-token cached logits == full-sequence recompute."""
+        tok = jnp.asarray(
+            np.random.default_rng(0).integers(0, CFG.vocab_size, (2, 12)),
+            jnp.int32,
+        )
+        full = GPT2(CFG).apply({"params": params}, tok)
+
+        dec = GPT2(CFG, decode=True)
+        cache = init_cache(dec, params, 2, 12)
+        outs = []
+        for i in range(12):
+            logits, mut = dec.apply(
+                {"params": params, "cache": cache}, tok[:, i : i + 1],
+                mutable=["cache"],
+            )
+            cache = mut["cache"]
+            outs.append(logits[:, 0])
+        inc = jnp.stack(outs, axis=1)
+        np.testing.assert_allclose(
+            np.asarray(inc), np.asarray(full), atol=2e-4
+        )
+
+    def test_chunked_prefill_matches_full(self, params):
+        tok = jnp.asarray(
+            np.random.default_rng(1).integers(0, CFG.vocab_size, (1, 16)),
+            jnp.int32,
+        )
+        full = GPT2(CFG).apply({"params": params}, tok)
+        dec = GPT2(CFG, decode=True)
+        cache = init_cache(dec, params, 1, 16)
+        l1, mut = dec.apply(
+            {"params": params, "cache": cache}, tok[:, :10], mutable=["cache"]
+        )
+        l2, _ = dec.apply(
+            {"params": params, "cache": mut["cache"]}, tok[:, 10:],
+            mutable=["cache"],
+        )
+        got = jnp.concatenate([l1, l2], axis=1)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(full), atol=2e-4
+        )
+
+
+class TestGenerate:
+    def test_greedy_deterministic_and_in_range(self, params):
+        model = GPT2(CFG, decode=True)
+        prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        out1 = generate(model, params, prompt, 8, temperature=0.0)
+        out2 = generate(model, params, prompt, 8, temperature=0.0)
+        assert out1.shape == (1, 12)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+        np.testing.assert_array_equal(np.asarray(out1[:, :4]), np.asarray(prompt))
+        assert np.all(np.asarray(out1) >= 0)
+        assert np.all(np.asarray(out1) < CFG.vocab_size)
+
+    def test_greedy_matches_dense_argmax_rollout(self, params):
+        """Cached greedy rollout == naive full-recompute greedy rollout."""
+        model = GPT2(CFG, decode=True)
+        dense = GPT2(CFG)
+        prompt = jnp.asarray([[5, 9, 2]], jnp.int32)
+        out = generate(model, params, prompt, 6, temperature=0.0)
+
+        toks = prompt
+        for _ in range(6):
+            logits = dense.apply({"params": params}, toks)
+            nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None]
+            toks = jnp.concatenate([toks, nxt.astype(toks.dtype)], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
+    def test_top_k_masks_tail(self):
+        logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0]])
+        for seed in range(10):
+            tok = sample_logits(
+                logits, jax.random.PRNGKey(seed), temperature=1.0, top_k=2
+            )
+            assert int(tok[0]) in (2, 3)
+
+    def test_length_cap_raises(self, params):
+        model = GPT2(CFG, decode=True)
+        prompt = jnp.zeros((1, 60), jnp.int32)
+        with pytest.raises(ValueError, match="exceeds"):
+            generate(model, params, prompt, 8)

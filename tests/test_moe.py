@@ -30,8 +30,8 @@ class TestRouting:
         wg = np.asarray(params["router"])
         logits = tokens @ wg
         probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
-        w1, b1 = np.asarray(params["moe/w1"]), np.asarray(params["moe/b1"])
-        w2, b2 = np.asarray(params["moe/w2"]), np.asarray(params["moe/b2"])
+        w1, b1 = np.asarray(params["expert_w1"]), np.asarray(params["expert_b1"])
+        w2, b2 = np.asarray(params["expert_w2"]), np.asarray(params["expert_b2"])
         expected = np.zeros_like(tokens)
         for i, tok in enumerate(tokens):
             e = probs[i].argmax()
@@ -79,10 +79,10 @@ class TestExpertParallel:
         )
         sharded = {
             "router": shard(params["router"], P()),
-            "moe/w1": shard(params["moe/w1"], P("ep")),
-            "moe/b1": shard(params["moe/b1"], P("ep")),
-            "moe/w2": shard(params["moe/w2"], P("ep")),
-            "moe/b2": shard(params["moe/b2"], P("ep")),
+            "expert_w1": shard(params["expert_w1"], P("ep")),
+            "expert_b1": shard(params["expert_b1"], P("ep")),
+            "expert_w2": shard(params["expert_w2"], P("ep")),
+            "expert_b2": shard(params["expert_b2"], P("ep")),
         }
         with jax.set_mesh(mesh):
             y, aux = jax.jit(
@@ -104,7 +104,7 @@ class TestExpertParallel:
         mesh = make_mesh(MeshSpec(dp=2, ep=4), devices=devices8)
         policy = TensorParallel(rules=MOE_RULES)
         specs = policy.params_specs(params, mesh)
-        assert specs["moe/w1"] == P("ep", None, None)
+        assert specs["expert_w1"] == P("ep", None, None)
         assert specs["router"] == P(None, None)
 
 
