@@ -16,20 +16,97 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
-import jax
-import jax.numpy as jnp
-
 BASELINE_IMG_PER_SEC = 6000.0  # per-chip A100-class estimate; see docstring
-BATCH = 18  # Stoke-DDP.py:159 default batch size per device
+BATCH = int(os.environ.get("GRAFT_BENCH_BATCH", "18"))  # Stoke-DDP.py:159
 PATCH = 64  # Stoke-DDP.py:207 img_size
-STEPS = 20
-WARMUP = 3
+STEPS = int(os.environ.get("GRAFT_BENCH_STEPS", "20"))
+WARMUP = int(os.environ.get("GRAFT_BENCH_WARMUP", "3"))
+
+METRIC = "swinir_s_x2_train_images_per_sec_per_chip"
+UNIT = "images/sec/chip"
+ATTEMPTS = int(os.environ.get("GRAFT_BENCH_ATTEMPTS", "3"))  # TPU init is flaky
+ATTEMPT_TIMEOUT_S = int(os.environ.get("GRAFT_BENCH_TIMEOUT", "900"))
+RETRY_BACKOFF_S = int(os.environ.get("GRAFT_BENCH_BACKOFF", "20"))
 
 
 def main() -> None:
+    """Run the bench in a child process with bounded retries.
+
+    Round 1's official artifact was a bare ``JaxRuntimeError: UNAVAILABLE``
+    stack trace from TPU backend init (`BENCH_r01.json` rc=1), and the
+    backend can also *hang* rather than fail, which no in-process
+    try/except survives. So the parent re-execs itself as a child with a
+    hard timeout and retries; the only things it ever prints are the
+    child's one JSON result line or a one-line JSON error record.
+    """
+    if os.environ.get("_GRAFT_BENCH_CHILD") == "1":
+        _bench()
+        return
+    err = "unknown"
+    for attempt in range(1, ATTEMPTS + 1):
+        env = dict(os.environ)
+        env["_GRAFT_BENCH_CHILD"] = "1"
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-u", os.path.abspath(__file__)],
+                env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True,
+                text=True,
+                timeout=ATTEMPT_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            err = f"attempt {attempt}: timed out after {ATTEMPT_TIMEOUT_S}s"
+            continue
+        result = _extract_json_line(proc.stdout)
+        if proc.returncode == 0 and result is not None:
+            print(result)
+            return
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        err = f"attempt {attempt} rc={proc.returncode}: " + (
+            tail[-1][:300] if tail else "no output"
+        )
+        if attempt < ATTEMPTS:
+            time.sleep(RETRY_BACKOFF_S)
+    print(
+        json.dumps(
+            {
+                "metric": METRIC,
+                "value": 0.0,
+                "unit": UNIT,
+                "vs_baseline": 0.0,
+                "error": f"TPU bench failed after {ATTEMPTS} attempts: {err}",
+            }
+        )
+    )
+    sys.exit(1)
+
+
+def _extract_json_line(stdout: str) -> str | None:
+    """Last stdout line that parses as the result record, if any."""
+    for line in reversed((stdout or "").strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "metric" in rec and "value" in rec:
+            return line
+    return None
+
+
+def _bench() -> None:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
     from pytorch_distributedtraining_tpu import optim
     from pytorch_distributedtraining_tpu.losses import mse_loss
     from pytorch_distributedtraining_tpu.models import SwinIR
@@ -91,9 +168,9 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "swinir_s_x2_train_images_per_sec_per_chip",
+                "metric": METRIC,
                 "value": round(img_per_sec, 2),
-                "unit": "images/sec/chip",
+                "unit": UNIT,
                 "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
             }
         )

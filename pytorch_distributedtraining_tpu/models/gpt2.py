@@ -156,12 +156,14 @@ class GPT2(nn.Module):
         wpe = self.param(
             "wpe", nn.initializers.normal(0.01), (cfg.n_positions, cfg.n_embd)
         )
+        start_index = None  # blocks' global KV write position this call
         if self.decode and self.has_variable("cache", "position"):
             pos_var = self.variable(
                 "cache", "position", lambda: jnp.zeros((), jnp.int32)
             )
-            pos = pos_var.value + jnp.arange(t)
-            pos_var.value = pos_var.value + t
+            start_index = pos_var.value
+            pos = start_index + jnp.arange(t)
+            pos_var.value = start_index + t
             pe = wpe[pos]
         else:
             if self.decode:  # init pass: create the position counter
@@ -177,7 +179,7 @@ class GPT2(nn.Module):
             block_cls = nn.remat(Block, static_argnums=(2,))  # (self, x, det)
         for i in range(cfg.n_layer):
             x = block_cls(cfg, self.attn_fn, self.decode, name=f"h_{i}")(
-                x, deterministic
+                x, deterministic, start_index
             )
 
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)

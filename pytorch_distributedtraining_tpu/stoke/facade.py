@@ -23,6 +23,7 @@ Grad accumulation follows Stoke semantics: ``.backward`` scales by
 from __future__ import annotations
 
 import inspect
+import weakref
 from typing import Any, Callable
 
 import numpy as np
@@ -68,6 +69,137 @@ class _ModelAccess:
 
     def __getattr__(self, name):
         return getattr(self._facade._module, name)
+
+
+def _forward_op(name):
+    def op(self, *args):
+        return getattr(self.materialize(), name)(*args)
+
+    op.__name__ = name
+    return op
+
+
+class _LazyBase:
+    """Shared machinery for deferred values: any use outside the fused
+    ``loss → backward`` flow transparently materializes through the compiled
+    programs, so the handles behave like the jax arrays they stand for
+    (arithmetic, comparisons, indexing, numpy conversion, iteration)."""
+
+    __slots__ = ("_facade", "_value", "__weakref__")
+
+    def materialize(self):  # overridden
+        raise NotImplementedError
+
+    def __jax_array__(self):
+        return self.materialize()
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(jax.device_get(self.materialize()))
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __getitem__(self, idx):
+        return self.materialize()[idx]
+
+    def __len__(self):
+        return len(self.materialize())
+
+    def __iter__(self):
+        return iter(self.materialize())
+
+    def __float__(self):
+        return float(jax.device_get(self.materialize()))
+
+    def __bool__(self):
+        return bool(self.materialize())
+
+    def __format__(self, spec):
+        return format(float(self), spec) if spec else repr(self)
+
+    def __getattr__(self, name):
+        return getattr(self.materialize(), name)
+
+    def __repr__(self):
+        state = "pending" if self._value is None else "materialized"
+        return f"{type(self).__name__}<{state}>"
+
+
+for _name in (
+    "__add__", "__radd__", "__sub__", "__rsub__", "__mul__", "__rmul__",
+    "__truediv__", "__rtruediv__", "__pow__", "__neg__", "__abs__",
+    "__eq__", "__ne__", "__lt__", "__le__", "__gt__", "__ge__",
+    "__matmul__", "__rmatmul__", "__mod__",
+):
+    setattr(_LazyBase, _name, _forward_op(_name))
+_LazyBase.__hash__ = object.__hash__  # __eq__ above would otherwise drop it
+
+
+class _LazyOutput(_LazyBase):
+    """Deferred forward result from ``.model()`` on the training path.
+
+    The reference loop is ``out = s.model(x); l = s.loss(out, y);
+    s.backward(l); s.step()`` (`Stoke-DDP.py:73-82`). Running the forward
+    inside ``.model()`` *and* again under grad inside ``.backward()`` pays
+    2x forward; deferring it means the common loop executes exactly one
+    compiled fwd+bwd program. The handle captures the params/model-state/rng
+    in effect at the ``.model()`` call, so late materialization reproduces
+    exactly what an eager forward would have computed — even after
+    ``.step()`` has updated (and donated) the live params; ``.step()``
+    force-materializes still-pending handles before donation invalidates
+    their buffers. ``.shape``/``.dtype``/``.ndim`` come from ``eval_shape``
+    without running the forward.
+    """
+
+    __slots__ = ("_inputs", "_params", "_model_state", "_rng")
+
+    def __init__(self, facade, inputs, params, model_state, rng):
+        self._facade = facade
+        self._inputs = inputs
+        self._params = params
+        self._model_state = model_state
+        self._rng = rng
+        self._value = None
+
+    def materialize(self):
+        if self._value is None:
+            self._value, _ = self._facade._jit_fwd(
+                self._params, self._model_state, self._inputs, self._rng,
+                train=True,
+            )
+        return self._value
+
+    @property
+    def _aval(self):
+        out, _ = jax.eval_shape(
+            lambda p, m, x, r: self._facade._jit_fwd(p, m, x, r, train=True),
+            self._params, self._model_state, self._inputs, self._rng,
+        )
+        return out
+
+    def __getattr__(self, name):
+        if self._value is None and name in ("shape", "dtype", "ndim", "size"):
+            return getattr(self._aval, name)
+        return getattr(self.materialize(), name)
+
+
+class _LazyLoss(_LazyBase):
+    """Deferred loss from ``.loss()``; resolved for free by ``.backward()``
+    (which computes the true loss inside the fused grad program) or on
+    demand via the compiled forward + loss programs."""
+
+    __slots__ = ("_output", "_targets")
+
+    def __init__(self, facade, output, targets):
+        self._facade = facade
+        self._output = output
+        self._targets = targets
+        self._value = None
+
+    def materialize(self):
+        if self._value is None:
+            self._value = self._facade._materialize_loss(
+                self._output, self._targets
+            )
+        return self._value
 
 
 class Stoke:
@@ -183,6 +315,9 @@ class Stoke:
         self._last_inputs = None
         self._last_targets = None
         self._last_loss = None
+        self._lazy_output = None
+        self._lazy_loss = None
+        self._pending_lazies = []  # weakref.ref of unresolved handles
         self._backward_count = 0
         self._grad_acc = None
         self._accepts_train = self._model_accepts("train")
@@ -275,12 +410,12 @@ class Stoke:
                     if scaler_state is not None
                     else loss
                 )
-                return scaled, (loss, new_state)
+                return scaled, (loss, precision.cast_to_output(out), new_state)
 
-            (_, (loss, new_state)), grads = jax.value_and_grad(lfn, has_aux=True)(
-                params
-            )
-            return loss, new_state, grads
+            (_, (loss, out, new_state)), grads = jax.value_and_grad(
+                lfn, has_aux=True
+            )(params)
+            return loss, out, new_state, grads
 
         self._jit_loss_grad = jax.jit(loss_grad)
 
@@ -341,22 +476,52 @@ class Stoke:
 
     def model(self, inputs):
         """Forward pass (`Stoke-DDP.py:73,116`). Lazily initializes params
-        from the first batch's shapes."""
+        from the first batch's shapes.
+
+        In training mode the forward is *deferred*: the returned handle
+        materializes on any direct use, but when it only flows into
+        ``.loss → .backward`` the whole iteration runs as one compiled
+        fwd+bwd program (no double forward)."""
         if self._state is None:
             self.init(inputs)
         inputs = self._shard_batch(inputs)
         self._last_inputs = inputs
+        if self._training:
+            lazy = _LazyOutput(
+                self, inputs, self._state.params, self._state.model_state,
+                jax.random.fold_in(self._state.rng, self._state.step),
+            )
+            self._lazy_output = lazy
+            self._pending_lazies.append(weakref.ref(lazy))
+            return lazy
+        return self._run_forward(inputs, train=False)
+
+    def _run_forward(self, inputs, train: bool):
         rng = jax.random.fold_in(self._state.rng, self._state.step)
         out, _ = self._jit_fwd(
             self._state.params, self._state.model_state, inputs, rng,
-            train=self._training,
+            train=train,
         )
         return out
 
+    def _materialize_loss(self, output, targets):
+        """Fallback for direct use of a deferred loss before backward()."""
+        loss = self._jit_loss(output.materialize(), targets)
+        self._note_loss(loss)
+        return loss
+
     def loss(self, outputs, targets):
-        """Loss computation (`Stoke-DDP.py:74,118`)."""
+        """Loss computation (`Stoke-DDP.py:74,118`). Deferred when the
+        outputs are themselves deferred — ``.backward()`` then resolves it
+        from the fused grad program at zero extra cost."""
         targets = self._shard_batch(targets)
         self._last_targets = targets
+        if isinstance(outputs, _LazyOutput) and outputs._value is None:
+            lazy = _LazyLoss(self, outputs, targets)
+            self._lazy_loss = lazy
+            return lazy
+        if isinstance(outputs, _LazyOutput):
+            outputs = outputs.materialize()
         loss = self._jit_loss(outputs, targets)
         self._note_loss(loss)
         return loss
@@ -371,7 +536,7 @@ class Stoke:
                 "backward() needs a preceding model(inputs) and loss(outputs, targets)"
             )
         rng = jax.random.fold_in(self._state.rng, self._state.step)
-        loss_val, new_model_state, grads = self._jit_loss_grad(
+        loss_val, out, new_model_state, grads = self._jit_loss_grad(
             self._state.params,
             self._state.model_state,
             self._last_inputs,
@@ -380,13 +545,31 @@ class Stoke:
             self._state.scaler,
         )
         self._state = self._state.replace(model_state=new_model_state)
-        self._grad_acc = (
-            self._jit_acc_first(grads)
-            if self._grad_acc is None
-            else self._jit_acc(self._grad_acc, grads)
-        )
+        if self.grad_accum_steps == 1 and self._grad_acc is None:
+            self._grad_acc = grads  # scale 1/1 and f32 cast are no-ops
+        else:
+            self._grad_acc = (
+                self._jit_acc_first(grads)
+                if self._grad_acc is None
+                else self._jit_acc(self._grad_acc, grads)
+            )
         self._backward_count += 1
         self._note_loss(loss_val)
+        # resolve the deferred loss/output handles from the fused program's
+        # own results, so `detach_and_sync_loss(loss)` and any later use of
+        # the `.model()` output cost nothing extra
+        if isinstance(loss, _LazyLoss):
+            loss._value = loss_val
+        if self._lazy_loss is not None:
+            self._lazy_loss._value = loss_val
+            self._lazy_loss = None
+        if self._lazy_output is not None:
+            self._lazy_output._value = out
+            self._lazy_output = None
+        self._pending_lazies = [
+            r for r in self._pending_lazies
+            if r() is not None and r()._value is None
+        ]
         return loss_val
 
     def step(self):
@@ -396,6 +579,14 @@ class Stoke:
             return
         if self._backward_count % self.grad_accum_steps != 0:
             return
+        # any still-deferred handles hold references to the CURRENT params,
+        # whose buffers _jit_apply is about to donate — materialize them now
+        # so late use reproduces the pre-step forward instead of crashing
+        for ref in self._pending_lazies:
+            lazy = ref()
+            if lazy is not None:
+                lazy.materialize()
+        self._pending_lazies = []
         new_params, new_opt, new_scaler = self._jit_apply(
             self._state.params,
             self._state.opt_state,
@@ -421,6 +612,8 @@ class Stoke:
         """Cross-device mean of a loss for reporting (`Stoke-DDP.py:86`).
         Under SPMD the compiled loss is already the global mean; this pulls
         it to host as a float."""
+        if isinstance(loss, (_LazyLoss, _LazyOutput)):
+            loss = loss.materialize()
         return sync_scalar(loss)
 
     # -- fused fast path ---------------------------------------------------
@@ -649,7 +842,14 @@ class Stoke:
         print(f"[rank {self.rank}/{self.world_size}] {msg}", flush=True)
 
     def print_ema_loss(self, prepend_msg: str = "EMA Loss"):
-        """Smoothed-loss print (`Stoke-DDP.py:76`)."""
+        """Smoothed-loss print (`Stoke-DDP.py:76`).
+
+        On the fused training path the loss value only exists once
+        ``.backward()`` runs, so when called between ``.loss()`` and
+        ``.backward()`` (the reference's order) the printed EMA includes
+        every loss up to the *previous* iteration — a one-call display lag
+        on a 0.98-decay monitor, accepted to keep the hot loop at exactly
+        one compiled fwd+bwd program."""
         if self._ema_loss is not None and self.verbose:
             print(f"{prepend_msg}: {self._ema_loss:.6f}", flush=True)
 

