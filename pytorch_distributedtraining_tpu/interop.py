@@ -59,14 +59,38 @@ def _to_numpy_tree(obj):
     return obj
 
 
-def torch_to_flax_array(name: str, a: np.ndarray, target_shape) -> np.ndarray:
+def torch_to_flax_array(
+    name: str, a: np.ndarray, target_shape, *, is_kernel: bool = False
+) -> np.ndarray:
     """Convert one torch tensor to the flax layout ``target_shape`` expects.
 
     - Conv kernel  OIHW -> HWIO           (torch [O,I,kh,kw])
     - Linear kernel [out,in] -> [in,out]
     - everything else passes through (biases, norms, embeddings)
+
+    ``is_kernel=True`` marks leaves known to come from a torch
+    ``weight`` on a Dense/Conv module: those ALWAYS transpose. Shape
+    comparison alone cannot decide for square matrices (a [C, C] torch
+    linear weight matches the flax target shape untransposed — and loads
+    silently wrong).
     """
     target_shape = tuple(target_shape)
+    if is_kernel and a.ndim == 2:
+        a = a.T  # [out,in] -> [in,out]
+        if a.shape != target_shape:
+            raise ValueError(
+                f"linear kernel {name}: {a.T.shape} does not transpose onto "
+                f"{target_shape}"
+            )
+        return a
+    if is_kernel and a.ndim == 4:
+        a = np.transpose(a, (2, 3, 1, 0))  # OIHW -> HWIO
+        if a.shape != target_shape:
+            raise ValueError(
+                f"conv kernel {name}: OIHW source does not map onto "
+                f"{target_shape}"
+            )
+        return a
     if a.shape == target_shape:
         return a
     if a.ndim == 4 and tuple(np.transpose(a, (2, 3, 1, 0)).shape) == target_shape:
@@ -78,26 +102,46 @@ def torch_to_flax_array(name: str, a: np.ndarray, target_shape) -> np.ndarray:
     )
 
 
-def convert_torch_tensors(flat_torch: dict, flat_template: dict) -> dict:
-    """Layout-convert every torch leaf to its same-key template leaf."""
+def convert_torch_tensors(
+    flat_torch: dict, flat_template: dict, kernel_keys: set | None = None
+) -> dict:
+    """Layout-convert every torch leaf to its same-key template leaf.
+
+    ``kernel_keys``: flat keys known to originate from torch Dense/Conv
+    ``weight`` tensors (tracked through the rename step) — these transpose
+    unconditionally, closing the square-matrix ambiguity."""
+    kernel_keys = kernel_keys or set()
     out = {}
     for k, v in flat_torch.items():
         if k in flat_template:
-            out[k] = torch_to_flax_array(k, v, np.shape(flat_template[k]))
+            out[k] = torch_to_flax_array(
+                k, v, np.shape(flat_template[k]), is_kernel=k in kernel_keys
+            )
         else:
             out[k] = v
     return out
 
 
 def rewrite_keys(flat: dict, table: list[tuple[str, str]]) -> dict:
-    """Apply ``(regex, replacement)`` rewrites to flat ``a/b/c`` keys."""
+    """Apply ``(regex, replacement)`` rewrites to flat ``a/b/c`` keys.
+
+    A ``None`` replacement drops matching keys — for torch-only buffers
+    (e.g. SwinIR's ``relative_position_index`` / ``attn_mask``) that have
+    no twin in the functional param tree."""
     import re
 
     out = {}
     for k, v in flat.items():
+        dropped = False
         for pat, repl in table:
-            k = re.sub(pat, repl, k)
-        out[k] = v
+            if repl is None:
+                if re.search(pat, k):
+                    dropped = True
+                    break
+            else:
+                k = re.sub(pat, repl, k)
+        if not dropped:
+            out[k] = v
     return out
 
 
@@ -157,7 +201,8 @@ def load_torch_into_template(
         flat_src = {key_map.get(k, k): v for k, v in flat_src.items()}
     auto = default_torch_key_map(flat_src, flat_tpl)
     flat_src = {auto.get(k, k): v for k, v in flat_src.items()}
-    flat_src = convert_torch_tensors(flat_src, flat_tpl)
+    kernel_keys = {new for new in auto.values() if new.endswith("/kernel")}
+    flat_src = convert_torch_tensors(flat_src, flat_tpl, kernel_keys)
     return load_params_dict(
         flat_dict_to_tree(flat_src), template, strict=strict,
         param_key=param_key,

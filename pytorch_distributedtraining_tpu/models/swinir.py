@@ -77,6 +77,23 @@ def _shift_attn_mask(h: int, w: int, ws: int, shift: int) -> np.ndarray:
     return np.where(diff != 0, -100.0, 0.0).astype(np.float32)
 
 
+# (regex, repl) rewrites from the official torch-SwinIR state_dict naming
+# (the checkpoint family the reference loads, `Stoke-DDP.py:209-213`:
+# `layers.N.residual_group.blocks.M.*`) onto this module tree. Keys are the
+# "/"-joined flat form produced by interop.load_torch_checkpoint; `None`
+# replacement drops torch-only buffers. Leaf twins (weight->kernel/scale,
+# OIHW->HWIO) are handled downstream by interop's heuristics.
+TORCH_KEY_MAP = [
+    (r"(^|/)relative_position_index$", None),  # recomputed host-side
+    (r"(^|/)attn_mask$", None),  # recomputed per static (H, W)
+    (r"^layers/(\d+)/residual_group/blocks/(\d+)/", r"rstb_\1/layer_\2/"),
+    (r"^layers/(\d+)/conv/", r"rstb_\1/conv/"),
+    (r"/mlp/fc", "/fc"),
+    (r"^patch_embed/norm/", "patch_norm/"),
+    (r"^upsample/0/", "conv_up/"),  # UpsampleOneStep = Sequential(Conv, PS)
+]
+
+
 class WindowAttention(nn.Module):
     dim: int
     num_heads: int
@@ -214,7 +231,12 @@ class SwinIR(nn.Module):
             name="conv_first",
         )(x.astype(self.dtype))
 
-        y = feat
+        # torch SwinIR's patch_embed norm (patch_norm=True default): a
+        # channel LayerNorm between shallow conv and the RSTB body — kept so
+        # reference checkpoints map onto an identical function
+        y = nn.LayerNorm(dtype=jnp.float32, name="patch_norm")(feat).astype(
+            self.dtype
+        )
         for i, (depth, heads) in enumerate(zip(self.depths, self.num_heads)):
             y = RSTB(
                 self.embed_dim, depth, heads, ws, self.mlp_ratio,

@@ -1,16 +1,25 @@
 """Pallas TPU flash attention — the framework's hot-op custom kernel.
 
 The reference leans on cuDNN/ATen fused kernels for its hot ops (`SURVEY.md`
-§2.5 native checklist item 5); the TPU-native escape hatch is Pallas. This
-kernel computes blockwise attention with online softmax entirely in VMEM:
+§2.5 native checklist item 5); the TPU-native escape hatch is Pallas. The
+forward computes blockwise attention with online softmax entirely in VMEM:
 one [bq, dh] query tile stays resident while K/V stream through in [bk, dh]
 tiles — O(T) HBM traffic instead of the O(T^2) logits round-trip, f32
 accumulators on the MXU (`/opt/skills/guides/pallas_guide.md` patterns).
 
-Forward runs the Pallas kernel; backward is a custom VJP that recomputes
-attention with XLA ops (flash-style recompute — no O(T^2) residuals saved).
+The backward is the FlashAttention-2 scheme as two Pallas kernels with
+in-kernel recompute from the saved per-row logsumexp (no O(T^2) residuals
+ever touch HBM, fwd or bwd):
+
+  - dq kernel: one query tile resident, K/V stream; recomputes P from lse,
+    dS = P*(dO V^T - delta), dq += dS K.
+  - dk/dv kernel: one key tile resident, Q/dO stream; dv += P^T dO,
+    dk += dS^T Q.
+
+``delta = rowsum(dO * O)`` is a cheap elementwise XLA pass. Causal block
+skipping applies in all three kernels (upper-triangular tiles never run).
 ``make_flash_attn_fn`` returns a drop-in ``attn_fn`` for the model zoo and
-falls back to XLA attention off-TPU (CPU tests run ``interpret=True``).
+runs ``interpret=True`` off-TPU so CPU tests exercise the same kernels.
 """
 
 from __future__ import annotations
@@ -24,7 +33,7 @@ from jax.experimental import pallas as pl
 _BIG_NEG = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, causal, scale):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, causal, scale):
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, dh]
     t = k_ref.shape[2]
@@ -59,22 +68,122 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, causal, scale):
     # causal: blocks with j*bk > (qi+1)*bq - 1 are fully masked; skip them
     nk_run = jnp.minimum(nk, (qi + 1) * bq // bk + 1) if causal else nk
     acc, m, l = jax.lax.fori_loop(0, nk_run, body, (acc0, m0, l0))
-    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)  # per-row logsumexp of scaled logits
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, bq, bk, causal, scale,
+):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)  # [bq, dh]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]  # [bq]
+    delta = delta_ref[0, 0]  # [bq]
+    t = k_ref.shape[2]
+    nk = t // bk
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(kpos <= qpos, s, _BIG_NEG)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk], masked entries -> 0
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    nk_run = jnp.minimum(nk, (qi + 1) * bq // bk + 1) if causal else nk
+    dq = jax.lax.fori_loop(
+        0, nk_run, body, jnp.zeros((bq, q.shape[-1]), jnp.float32)
+    )
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, bq, bk, causal, scale,
+):
+    ki = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)  # [bk, dh]
+    v = v_ref[0, 0].astype(jnp.float32)
+    t = q_ref.shape[2]
+    dh = k.shape[-1]
+    nq = t // bq
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * bq, bq)]
+        delta = delta_ref[0, 0, pl.ds(i * bq, bq)]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if causal:
+            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(kpos <= qpos, s, _BIG_NEG)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, dh]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, dh]
+        return dk, dv
+
+    # causal: q tiles strictly above the diagonal band never attend this
+    # key tile — start at the first row tile whose end reaches ki*bk
+    i0 = (ki * bk) // bq if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        i0, nq, body,
+        (jnp.zeros((bk, dh), jnp.float32), jnp.zeros((bk, dh), jnp.float32)),
+    )
+    dk_ref[0, 0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _check_blocks(t, bq, bk):
+    if t % bq or t % bk:
+        raise ValueError(f"seq len {t} must divide block sizes ({bq},{bk})")
 
 
 def _flash_forward(q, k, v, *, causal, bq, bk, interpret):
+    """Returns (out, lse) in the caller's [B, T, H, Dh] layout for out and
+    [B, H, T] for lse."""
     b, t, h, dh = q.shape
-    bq = min(bq, t)
-    bk = min(bk, t)
-    if t % bq or t % bk:
-        raise ValueError(f"seq len {t} must divide block sizes ({bq},{bk})")
+    bq, bk = min(bq, t), min(bk, t)
+    _check_blocks(t, bq, bk)
     scale = 1.0 / (dh**0.5)
     # [B, H, T, Dh] — contiguous K/V streams per (batch, head) program
     qt, kt, vt = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
     grid = (b, h, t // bq)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
-            _flash_kernel, bq=bq, bk=bk, causal=causal, scale=scale
+            _fwd_kernel, bq=bq, bk=bk, causal=causal, scale=scale
         ),
         grid=grid,
         in_specs=[
@@ -82,11 +191,67 @@ def _flash_forward(q, k, v, *, causal, bq, bk, interpret):
             pl.BlockSpec((1, 1, t, dh), lambda b_, h_, i: (b_, h_, 0, 0)),
             pl.BlockSpec((1, 1, t, dh), lambda b_, h_, i: (b_, h_, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, dh), lambda b_, h_, i: (b_, h_, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, dh), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b_, h_, i: (b_, h_, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, t), jnp.float32),
+        ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    return out.transpose(0, 2, 1, 3), lse
+
+
+def _flash_backward(q, k, v, out, lse, do, *, causal, bq, bk, interpret):
+    b, t, h, dh = q.shape
+    bq, bk = min(bq, t), min(bk, t)
+    _check_blocks(t, bq, bk)
+    scale = 1.0 / (dh**0.5)
+    qt, kt, vt, ot, dot_ = (
+        a.transpose(0, 2, 1, 3) for a in (q, k, v, out, do)
+    )
+    # delta_i = dO_i . O_i — one elementwise pass, XLA fuses it
+    delta = jnp.sum(
+        dot_.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1
+    )  # [B, H, T]
+
+    tile_q = pl.BlockSpec((1, 1, bq, dh), lambda b_, h_, i: (b_, h_, i, 0))
+    tile_k = pl.BlockSpec((1, 1, bk, dh), lambda b_, h_, i: (b_, h_, i, 0))
+    full_seq = pl.BlockSpec((1, 1, t, dh), lambda b_, h_, i: (b_, h_, 0, 0))
+    row_q = pl.BlockSpec((1, 1, bq), lambda b_, h_, i: (b_, h_, i))
+    row_full = pl.BlockSpec((1, 1, t), lambda b_, h_, i: (b_, h_, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, bq=bq, bk=bk, causal=causal, scale=scale
+        ),
+        grid=(b, h, t // bq),
+        in_specs=[tile_q, full_seq, full_seq, tile_q, row_q, row_q],
+        out_specs=tile_q,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, dot_, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, bq=bq, bk=bk, causal=causal, scale=scale
+        ),
+        grid=(b, h, t // bk),
+        in_specs=[full_seq, tile_k, tile_k, full_seq, row_full, row_full],
+        out_specs=[tile_k, tile_k],
+        out_shape=[
+            jax.ShapeDtypeStruct(kt.shape, k.dtype),
+            jax.ShapeDtypeStruct(vt.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot_, lse, delta)
+    return (
+        dq.transpose(0, 2, 1, 3),
+        dk.transpose(0, 2, 1, 3),
+        dv.transpose(0, 2, 1, 3),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -95,34 +260,32 @@ def flash_attention(
     interpret: bool = False,
 ):
     """Flash attention. q/k/v: [B, T, H, Dh] -> [B, T, H, Dh]."""
-    return _flash_forward(
+    out, _ = _flash_forward(
         q, k, v, causal=causal, bq=bq, bk=bk, interpret=interpret
     )
+    return out
 
 
 def _fwd(q, k, v, causal, bq, bk, interpret):
-    out = _flash_forward(
+    out, lse = _flash_forward(
         q, k, v, causal=causal, bq=bq, bk=bk, interpret=interpret
     )
-    return out, (q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, bq, bk, interpret, res, g):
-    # flash-style recompute: re-derive attention with XLA ops and let AD
-    # produce the gradient — no O(T^2) residuals were materialized in fwd
-    from ..models.gpt2 import default_attention
-
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: default_attention(a, b, c, causal=causal),
-                    q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(
+        q, k, v, out, lse, g, causal=causal, bq=bq, bk=bk,
+        interpret=interpret,
+    )
 
 
 flash_attention.defvjp(_fwd, _bwd)
 
 
 def make_flash_attn_fn(*, bq: int = 128, bk: int = 128, interpret=None):
-    """Drop-in ``attn_fn`` for models/; XLA fallback off-TPU."""
+    """Drop-in ``attn_fn`` for models/; interpreted kernels off-TPU."""
 
     def attn_fn(q, k, v, *, causal: bool = True):
         interp = interpret

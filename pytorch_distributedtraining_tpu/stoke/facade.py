@@ -766,6 +766,15 @@ class Stoke:
         pass ``key_map`` (dict or ``[(regex, repl)]``) when the module paths
         themselves differ (see interop.load_torch_into_template)."""
         self._require_state()
+        if key_map is None:
+            from ..models.swinir import SwinIR as _SwinIR
+
+            if isinstance(self._module, _SwinIR):
+                # the reference's own checkpoint family loads unmodified
+                # (`Stoke-DDP.py:209-213` -> torch-SwinIR state_dict naming)
+                from ..models.swinir import TORCH_KEY_MAP
+
+                key_map = TORCH_KEY_MAP
         if isinstance(source, str):
             if source.endswith((".pth", ".pt")):
                 from ..interop import (

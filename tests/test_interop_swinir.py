@@ -1,0 +1,130 @@
+"""Torch-SwinIR checkpoint naming → framework params (VERDICT r1 missing #2).
+
+Builds a state_dict in the official torch-SwinIR naming
+(`layers.N.residual_group.blocks.M.*`, the family the reference loads at
+`Stoke-DDP.py:209-213`), nested under 'params' exactly like the
+002_lightweightSR checkpoints, including torch-only buffers, and proves a
+strict load through the facade reproduces the source model bit-for-bit.
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from pytorch_distributedtraining_tpu import losses
+from pytorch_distributedtraining_tpu.checkpoint import tree_to_flat_dict
+from pytorch_distributedtraining_tpu.models.swinir import SwinIR, TORCH_KEY_MAP
+from pytorch_distributedtraining_tpu.stoke import Stoke, StokeOptimizer
+
+torch = pytest.importorskip("torch")
+
+CFG = dict(
+    img_size=8, window_size=4, depths=(2, 2), embed_dim=16,
+    num_heads=(2, 2), mlp_ratio=2.0,
+)
+
+
+def _to_torch_name(flat_key: str) -> str:
+    """Inverse of TORCH_KEY_MAP + leaf twins: our flat key -> torch key."""
+    k = flat_key
+    k = re.sub(r"^rstb_(\d+)/layer_(\d+)/", r"layers.\1.residual_group.blocks.\2.", k)
+    k = re.sub(r"^rstb_(\d+)/conv/", r"layers.\1.conv.", k)
+    k = re.sub(r"^patch_norm/", "patch_embed.norm.", k)
+    k = re.sub(r"^conv_up/", "upsample.0.", k)
+    k = k.replace("/fc1/", "/mlp.fc1.").replace("/fc2/", "/mlp.fc2.")
+    k = k.replace("/", ".")
+    k = re.sub(r"\.(kernel|scale)$", ".weight", k)
+    return k
+
+
+def _to_torch_layout(a: np.ndarray) -> np.ndarray:
+    if a.ndim == 4:
+        return np.transpose(a, (3, 2, 0, 1))  # HWIO -> OIHW
+    if a.ndim == 2:
+        return a.T  # [in,out] -> [out,in]
+    return a
+
+
+def _torch_swinir_state_dict(params) -> dict:
+    sd = {}
+    for k, v in tree_to_flat_dict(jax.device_get(params)).items():
+        sd[_to_torch_name(k)] = torch.from_numpy(
+            np.array(_to_torch_layout(np.asarray(v)), copy=True)
+        )
+    # torch-only registered buffers present in real checkpoints; the loader
+    # must drop them under strict=True
+    n = CFG["window_size"] ** 2
+    sd["layers.0.residual_group.blocks.0.attn.relative_position_index"] = (
+        torch.zeros(n, n, dtype=torch.long)
+    )
+    sd["layers.0.residual_group.blocks.1.attn_mask"] = torch.zeros(4, n, n)
+    return sd
+
+
+def test_torch_swinir_checkpoint_strict_load(tmp_path):
+    model = SwinIR(**CFG)
+    x = np.random.default_rng(0).random((8, 8, 8, 3)).astype(np.float32)
+    src_params = model.init(jax.random.PRNGKey(1), x[:1])["params"]
+    ref_out = model.apply({"params": src_params}, x)
+
+    path = str(tmp_path / "swinir_lightweight_x2.pth")
+    torch.save({"params": _torch_swinir_state_dict(src_params)}, path)
+
+    s = Stoke(
+        model=SwinIR(**CFG),
+        optimizer=StokeOptimizer(optimizer="AdamW", optimizer_kwargs={"lr": 1e-3}),
+        loss=losses.mse_loss,
+        sample_input=x,
+        rng_seed=7,  # different init: loaded weights must fully overwrite
+    )
+    s.load_model_state(path, strict=True)  # key_map auto-applied for SwinIR
+
+    for a, b in zip(
+        jax.tree.leaves(src_params), jax.tree.leaves(s.state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    s.model_access.eval()
+    out = np.asarray(s.model(x))
+    # facade forward runs dp-sharded over 8 virtual devices: float
+    # reassociation vs the single-device reference apply
+    np.testing.assert_allclose(out, np.asarray(ref_out), atol=2e-5)
+
+
+def test_torch_swinir_missing_key_raises(tmp_path):
+    model = SwinIR(**CFG)
+    x = np.zeros((1, 8, 8, 3), np.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    sd = _torch_swinir_state_dict(params)
+    sd.pop("conv_first.weight")
+    path = str(tmp_path / "incomplete.pth")
+    torch.save({"params": sd}, path)
+    s = Stoke(
+        model=SwinIR(**CFG),
+        optimizer=StokeOptimizer(optimizer="AdamW", optimizer_kwargs={"lr": 1e-3}),
+        loss=losses.mse_loss,
+        sample_input=x,
+    )
+    with pytest.raises((KeyError, ValueError)):
+        s.load_model_state(path, strict=True)
+
+
+def test_key_map_covers_every_param():
+    """Every param leaf has a torch twin that maps back through
+    TORCH_KEY_MAP — no silent unmapped keys in either direction."""
+    from pytorch_distributedtraining_tpu.interop import rewrite_keys
+
+    model = SwinIR(**CFG)
+    x = np.zeros((1, 8, 8, 3), np.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    flat = tree_to_flat_dict(jax.device_get(params))
+    torch_keys = {_to_torch_name(k): None for k in flat}
+    back = rewrite_keys(
+        {k.replace(".", "/"): None for k in torch_keys}, TORCH_KEY_MAP
+    )
+    # after rewrite, the module path must match ours (leaf twins differ:
+    # weight vs kernel/scale — interop's heuristic handles those)
+    ours = {k.rpartition("/")[0] for k in flat}
+    theirs = {k.rpartition("/")[0] for k in back}
+    assert ours == theirs
