@@ -1,0 +1,257 @@
+"""Ablation profiler for the headline SwinIR-S bench (VERDICT r1 item 2).
+
+Times variants of the benched train step on the real chip in ONE process
+(TPU init is slow/flaky) to locate where the step time goes:
+
+  full        the exact bench.py step (fwd+bwd+AdamW+clip)
+  fwd_bwd     loss value_and_grad only, no optimizer update
+  fwd         forward+loss only
+  no_attnmm   WindowAttention's QK^T/softmax/AV replaced by identity on v
+              (keeps qkv + proj Dense) -- isolates the head_dim=10 matmuls
+  no_bias     attention without the relative-position-bias gather
+  bf16_ln     LayerNorms in bf16 instead of f32
+  batch72     full step at 4x batch (occupancy check)
+
+Prints one JSON line per variant: {"variant", "ms_per_step", "img_per_sec"}.
+Also prints XLA's own flops estimate for the full step (cost_analysis) and
+the implied MFU against v5e-class 197 TFLOP/s bf16 peak.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from pytorch_distributedtraining_tpu import optim
+from pytorch_distributedtraining_tpu.losses import mse_loss
+from pytorch_distributedtraining_tpu.models import SwinIR
+from pytorch_distributedtraining_tpu.models import swinir as swinir_mod
+from pytorch_distributedtraining_tpu.parallel import DDP, TrainStep, create_train_state
+from pytorch_distributedtraining_tpu.precision import Policy as Precision
+from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
+
+BATCH = 18
+PATCH = 64
+STEPS = 20
+WARMUP = 3
+PEAK_TFLOPS = 197.0  # v5e-class bf16
+
+
+def make_batch(batch):
+    rng = np.random.default_rng(0)
+    hr = rng.random((batch, 2 * PATCH, 2 * PATCH, 3)).astype(np.float32)
+    lr_img = hr.reshape(batch, PATCH, 2, PATCH, 2, 3).mean(axis=(2, 4))
+    d = jax.devices()[0]
+    return jax.device_put(lr_img, d), jax.device_put(hr, d)
+
+
+def build_step(model, batch):
+    mesh = make_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
+    tx = optim.adamw(lr=5e-4, clip_grad_norm=0.1)
+
+    def loss_fn(params, batch, rng, model_state):
+        lr_img, hr_img = batch
+        out = model.apply({"params": params}, lr_img)
+        return mse_loss(out, hr_img), {}
+
+    state, shardings = create_train_state(
+        init_fn=lambda rng: (
+            model.init(rng, jnp.zeros((1, PATCH, PATCH, 3)))["params"],
+            {},
+        ),
+        tx=tx,
+        mesh=mesh,
+        policy=DDP(),
+    )
+    step = TrainStep(
+        loss_fn, tx, mesh, DDP(),
+        precision=Precision(),
+        state_shardings=shardings,
+        extra_metrics=False,
+        donate=True,
+    )
+    return mesh, state, step, loss_fn
+
+
+def time_step(mesh, state, step, batch):
+    with mesh:
+        for _ in range(WARMUP):
+            state, metrics = step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            state, metrics = step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        return (time.perf_counter() - t0) / STEPS
+
+
+def time_fn(fn, *args):
+    out = None
+    for _ in range(WARMUP):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / STEPS
+
+
+def report(variant, sec, batch=BATCH):
+    print(json.dumps({
+        "variant": variant,
+        "ms_per_step": round(sec * 1e3, 3),
+        "img_per_sec": round(batch / sec, 1),
+    }), flush=True)
+
+
+def main():
+    model = SwinIR(dtype=jnp.bfloat16)
+    batch = make_batch(BATCH)
+    mesh, state, step, loss_fn = build_step(model, batch)
+
+    # XLA's flops estimate for the exact benched program
+    lowered = jax.jit(
+        lambda s, b: step._step(s, b, jnp.float32(1.0))
+    ).lower(state, batch)
+    cost = lowered.compile().cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0]
+    flops = float(cost.get("flops", 0.0))
+    print(json.dumps({"xla_flops_per_step": flops,
+                      "flops_per_img": flops / BATCH}), flush=True)
+
+    sec = time_step(mesh, state, step, batch)
+    report("full", sec)
+    print(json.dumps({
+        "mfu_full": round(flops / sec / (PEAK_TFLOPS * 1e12), 4)
+    }), flush=True)
+
+    # fwd+bwd only
+    params = state.params
+
+    @jax.jit
+    def fwd_bwd(p, b):
+        def lfn(p):
+            pc = jax.tree.map(lambda x: x, p)
+            l, _ = loss_fn(pc, b, None, {})
+            return l
+        return jax.value_and_grad(lfn)(p)
+
+    report("fwd_bwd", time_fn(fwd_bwd, params, batch))
+
+    @jax.jit
+    def fwd(p, b):
+        return loss_fn(p, b, None, {})[0]
+
+    report("fwd", time_fn(fwd, params, batch))
+
+    # --- model ablations (fwd+bwd, same shape of loss) -------------------
+    def ablate(model_cls_kwargs, name):
+        m = SwinIR(dtype=jnp.bfloat16, **model_cls_kwargs)
+        p = m.init(jax.random.PRNGKey(0), jnp.zeros((1, PATCH, PATCH, 3)))["params"]
+
+        @jax.jit
+        def fb(p, b):
+            def lfn(p):
+                out = m.apply({"params": p}, b[0])
+                return mse_loss(out, b[1])
+            return jax.value_and_grad(lfn)(p)
+
+        report(name, time_fn(fb, p, batch))
+
+    # monkeypatched attention without the attn matmuls: y = proj(qkv_v)
+    orig_call = swinir_mod.WindowAttention.__call__
+
+    def no_attnmm(self, x, mask=None):
+        bn, n, c = x.shape
+        h = self.num_heads
+        head_dim = c // h
+        qkv = nn.Dense(3 * c, use_bias=True, dtype=self.dtype, name="qkv")(x)
+        qkv = qkv.reshape(bn, n, 3, h, head_dim).transpose(2, 0, 3, 1, 4)
+        v = qkv[2]
+        out = v.transpose(0, 2, 1, 3).reshape(bn, n, c)
+        return nn.Dense(c, dtype=self.dtype, name="proj")(out)
+
+    swinir_mod.WindowAttention.__call__ = no_attnmm
+    try:
+        ablate({}, "no_attnmm")
+    finally:
+        swinir_mod.WindowAttention.__call__ = orig_call
+
+    # attention without the relative-position-bias add
+    def no_bias(self, x, mask=None):
+        bn, n, c = x.shape
+        h = self.num_heads
+        head_dim = c // h
+        qkv = nn.Dense(3 * c, use_bias=True, dtype=self.dtype, name="qkv")(x)
+        qkv = qkv.reshape(bn, n, 3, h, head_dim).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        scale = head_dim**-0.5
+        attn = (q * scale) @ k.transpose(0, 1, 3, 2)
+        # keep the param so init matches; skip gather+add
+        self.param(
+            "relative_position_bias_table",
+            nn.initializers.truncated_normal(0.02),
+            ((2 * self.window_size - 1) ** 2, h),
+        )
+        if mask is not None:
+            nw = mask.shape[0]
+            attn = attn.reshape(bn // nw, nw, h, n, n) + mask[None, :, None].astype(attn.dtype)
+            attn = attn.reshape(bn, h, n, n)
+        attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(self.dtype)
+        out = (attn @ v).transpose(0, 2, 1, 3).reshape(bn, n, c)
+        return nn.Dense(c, dtype=self.dtype, name="proj")(out)
+
+    swinir_mod.WindowAttention.__call__ = no_bias
+    try:
+        ablate({}, "no_bias")
+    finally:
+        swinir_mod.WindowAttention.__call__ = orig_call
+
+    # bf16 softmax (no f32 round-trip)
+    def bf16_softmax(self, x, mask=None):
+        bn, n, c = x.shape
+        h = self.num_heads
+        head_dim = c // h
+        qkv = nn.Dense(3 * c, use_bias=True, dtype=self.dtype, name="qkv")(x)
+        qkv = qkv.reshape(bn, n, 3, h, head_dim).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        scale = head_dim**-0.5
+        attn = (q * scale) @ k.transpose(0, 1, 3, 2)
+        table = self.param(
+            "relative_position_bias_table",
+            nn.initializers.truncated_normal(0.02),
+            ((2 * self.window_size - 1) ** 2, h),
+        )
+        idx = swinir_mod._relative_position_index(self.window_size)
+        bias = table[idx.reshape(-1)].reshape(n, n, h).transpose(2, 0, 1)
+        attn = attn + bias[None].astype(attn.dtype)
+        if mask is not None:
+            nw = mask.shape[0]
+            attn = attn.reshape(bn // nw, nw, h, n, n) + mask[None, :, None].astype(attn.dtype)
+            attn = attn.reshape(bn, h, n, n)
+        attn = jax.nn.softmax(attn, axis=-1)
+        out = (attn @ v).transpose(0, 2, 1, 3).reshape(bn, n, c)
+        return nn.Dense(c, dtype=self.dtype, name="proj")(out)
+
+    swinir_mod.WindowAttention.__call__ = bf16_softmax
+    try:
+        ablate({}, "bf16_softmax")
+    finally:
+        swinir_mod.WindowAttention.__call__ = orig_call
+
+    # occupancy: 4x batch through the full step
+    batch72 = make_batch(4 * BATCH)
+    mesh2, state2, step2, _ = build_step(model, batch72)
+    report("batch72", time_step(mesh2, state2, step2, batch72), batch=4 * BATCH)
+
+
+if __name__ == "__main__":
+    main()
