@@ -1,0 +1,126 @@
+"""Quality experiment: which perceptual loss trains the better SR model?
+
+VERDICT r1 item 8: the reference's ``feat_loss`` is a pretrained-VGG
+perceptual loss (`/root/reference/Stoke-DDP.py:35,224`); no VGG weights can
+exist in this zero-egress build env, so this experiment quantifies what the
+shipped fallbacks give up. Trains the same ESPCN ``Net`` from the same init
+on the same synthetic-but-structured image distribution under each loss and
+reports held-out PSNR/MAE (the reference's own quality metrics,
+`Stoke-DDP.py:120-121`):
+
+  mse          nn.MSELoss twin (the Fairscale driver's loss)
+  feat_random  shipped FeatLoss: fixed random 3-level conv pyramid + L1
+  vgg_random   VGGFeatLoss with He-init VGG-16 column (architecture parity,
+               random features)
+
+Images are sums of random low-frequency Fourier modes plus sharp box edges
+— smooth regions AND discontinuities, so pixel vs feature losses actually
+trade off. One JSON line per arm. Results recorded in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributedtraining_tpu import optim
+from pytorch_distributedtraining_tpu.losses import FeatLoss, VGGFeatLoss, mse_loss
+from pytorch_distributedtraining_tpu.metrics import mae, psnr
+from pytorch_distributedtraining_tpu.models import Net
+
+STEPS = 300
+BATCH = 16
+HR = 32
+
+
+def synth_images(n, rng):
+    """[n, HR, HR, 3] in [0,1]: low-freq Fourier fields + random boxes."""
+    yy, xx = np.meshgrid(np.arange(HR), np.arange(HR), indexing="ij")
+    imgs = np.zeros((n, HR, HR, 3), np.float32)
+    for i in range(n):
+        img = np.zeros((HR, HR, 3), np.float32)
+        for _ in range(4):  # smooth structure
+            fy, fx = rng.uniform(0.5, 3.0, 2)
+            ph = rng.uniform(0, 2 * np.pi, 3)
+            amp = rng.uniform(0.1, 0.4, 3)
+            for ch in range(3):
+                img[..., ch] += amp[ch] * np.sin(
+                    2 * np.pi * (fy * yy + fx * xx) / HR + ph[ch]
+                )
+        for _ in range(3):  # sharp edges
+            y0, x0 = rng.integers(0, HR - 8, 2)
+            h, w = rng.integers(4, 12, 2)
+            img[y0:y0 + h, x0:x0 + w] += rng.uniform(-0.5, 0.5, 3)
+        imgs[i] = img
+    lo, hi = imgs.min(), imgs.max()
+    return (imgs - lo) / (hi - lo + 1e-8)
+
+
+def downsample(hr):
+    n, h, w, c = hr.shape
+    return hr.reshape(n, h // 2, 2, w // 2, 2, c).mean(axis=(2, 4))
+
+
+def run_arm(name, loss_obj, train_hr, val_hr, init_params):
+    model = Net(upscale_factor=2)
+    tx = optim.adamw(lr=2e-3)
+    params = init_params
+    opt_state = tx.init(params)
+    train_lr = downsample(train_hr)
+    val_lr = downsample(val_hr)
+
+    @jax.jit
+    def step(params, opt_state, lr_img, hr_img):
+        def lfn(p):
+            return loss_obj(model.apply({"params": p}, lr_img), hr_img)
+
+        loss, grads = jax.value_and_grad(lfn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        import optax
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    @jax.jit
+    def evaluate(params):
+        out = model.apply({"params": params}, val_lr)
+        return psnr(out, val_hr), mae(out, val_hr)
+
+    n = train_hr.shape[0]
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        sel = rng.integers(0, n, BATCH)
+        params, opt_state, loss = step(
+            params, opt_state, train_lr[sel], train_hr[sel]
+        )
+    p, m = evaluate(params)
+    print(json.dumps({
+        "arm": name,
+        "val_psnr_db": round(float(p), 3),
+        "val_mae": round(float(m), 5),
+        "steps": STEPS,
+        "train_sec": round(time.perf_counter() - t0, 1),
+    }), flush=True)
+
+
+def main():
+    rng = np.random.default_rng(42)
+    train_hr = synth_images(256, rng)
+    val_hr = synth_images(64, rng)
+
+    model = Net(upscale_factor=2)
+    init_params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, HR // 2, HR // 2, 3))
+    )["params"]
+
+    run_arm("mse", lambda o, t: mse_loss(o, t), train_hr, val_hr, init_params)
+    run_arm("feat_random", FeatLoss(), train_hr, val_hr, init_params)
+    run_arm("vgg_random", VGGFeatLoss(), train_hr, val_hr, init_params)
+
+
+if __name__ == "__main__":
+    main()

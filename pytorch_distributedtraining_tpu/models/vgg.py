@@ -1,0 +1,79 @@
+"""VGG-16 feature extractor — the backbone of the reference's perceptual loss.
+
+The reference trains SwinIR against ``feat_loss`` from the missing
+``PyTorchPercept`` module (`/root/reference/Stoke-DDP.py:35,224`), the
+standard VGG-feature perceptual loss. This is the torchvision
+``vgg16().features`` column re-expressed in Flax/NHWC so that a reference
+user's downloaded ``vgg16-*.pth`` loads *exactly* (layer-for-layer key map,
+OIHW→HWIO handled by interop) and the loss compares the same activations.
+
+Layer indexing mirrors the torch ``nn.Sequential`` — conv at sequential
+index N is named ``conv_N`` — so the state-dict map is mechanical:
+``features.N.weight → conv_N/kernel``. ReLU taps follow the common
+perceptual-loss choice relu1_2 / relu2_2 / relu3_3 / relu4_3 / relu5_3
+(sequential indices 3, 8, 15, 22, 29).
+
+No weights ship with this repo (zero-egress build env); see
+``losses.VGGFeatLoss`` for the pretrained-load path and the documented
+random-init fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# torchvision vgg16 cfg "D": conv channel plan with 'M' = 2x2 maxpool.
+_VGG16_PLAN = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+               512, 512, 512, "M", 512, 512, 512, "M")
+
+# sequential indices of the ReLU taps used by the loss
+RELU_TAPS = (3, 8, 15, 22, 29)
+
+# ImageNet normalization (torchvision transforms convention)
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+# (regex, repl) from torchvision vgg16 state_dict naming onto this module.
+# classifier.* heads are dropped — only the feature column matters here.
+TORCH_KEY_MAP = [
+    (r"^classifier/.*$", None),
+    (r"^features/(\d+)/", r"conv_\1/"),
+]
+
+
+class VGG16Features(nn.Module):
+    """NHWC VGG-16 feature column; returns activations at ``taps``.
+
+    Input is expected in [0, 1]; ImageNet normalization is applied inside
+    (matching the torchvision preprocessing the reference loss rides).
+    """
+
+    taps: Sequence[int] = RELU_TAPS
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # [B, H, W, 3] in [0, 1]
+        mean = jnp.asarray(IMAGENET_MEAN, x.dtype)
+        std = jnp.asarray(IMAGENET_STD, x.dtype)
+        x = ((x - mean) / std).astype(self.dtype)
+
+        feats = []
+        idx = 0  # torch sequential index
+        for item in _VGG16_PLAN:
+            if item == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+                idx += 1
+                continue
+            x = nn.Conv(
+                item, (3, 3), padding="SAME", dtype=self.dtype,
+                name=f"conv_{idx}",
+            )(x)
+            idx += 1
+            x = nn.relu(x)
+            if idx in self.taps:  # idx now points at the ReLU slot
+                feats.append(x)
+            idx += 1
+        return feats

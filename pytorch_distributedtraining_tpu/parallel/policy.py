@@ -34,6 +34,11 @@ class Policy:
     shard_grads: bool = False
     min_shard_size: int = 1024
     remat: bool = False  # rematerialize the forward in backward (FSDP memory)
+    # DeepSpeed optimizer-offload twin (`Stoke-DDP.py:18` config surface):
+    # optimizer state lives in pinned host memory, streamed to the chip for
+    # the update. Falls back to HBM on backends without host-placement
+    # support (see spec.host_offload_supported).
+    offload_opt_state: bool = False
 
     # -- spec builders (trees of PartitionSpec) ----------------------------
 

@@ -49,11 +49,47 @@ def tree_specs(tree, axis_name: str | None, axis_size: int, min_size: int = 1024
     )
 
 
-def tree_shardings(tree_of_specs, mesh: Mesh):
+def tree_shardings(tree_of_specs, mesh: Mesh, *, memory_kind: str | None = None):
+    """Bind a tree of PartitionSpecs to ``mesh``.
+
+    ``memory_kind="pinned_host"`` places the leaves in host memory (the
+    DeepSpeed optimizer-offload twin): XLA:TPU streams them over PCIe
+    during the update instead of holding them in HBM.
+    """
     return jax.tree.map(
-        lambda s: NamedSharding(mesh, s), tree_of_specs,
+        lambda s: NamedSharding(mesh, s, memory_kind=memory_kind),
+        tree_of_specs,
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+def host_offload_supported(mesh: Mesh) -> bool:
+    """Can this backend run jitted programs with pinned_host operands?
+
+    TPU (and GPU) register the device-placement custom call; the CPU
+    backend does not (as of jax 0.9: ``annotate_device_placement`` is
+    unimplemented for Host) — so offload configs fall back to device
+    memory there rather than failing multichip dryruns and tests.
+    Probe-compiles a trivial program once per backend platform.
+    """
+    platform = mesh.devices.flat[0].platform
+    if platform in _HOST_OFFLOAD_SUPPORT:
+        return _HOST_OFFLOAD_SUPPORT[platform]
+    try:
+        s = NamedSharding(mesh, P(), memory_kind="pinned_host")
+        import jax.numpy as jnp
+
+        jax.jit(lambda x: x * 2, in_shardings=s, out_shardings=s).lower(
+            jax.ShapeDtypeStruct((8,), jnp.float32)
+        ).compile()
+        ok = True
+    except Exception:
+        ok = False
+    _HOST_OFFLOAD_SUPPORT[platform] = ok
+    return ok
+
+
+_HOST_OFFLOAD_SUPPORT: dict = {}
 
 
 def constrain(tree, tree_of_specs, mesh: Mesh):

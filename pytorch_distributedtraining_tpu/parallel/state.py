@@ -21,9 +21,13 @@ import jax.numpy as jnp
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+import logging
+
 from ..precision import ScalerState
 from .policy import Policy
-from .spec import tree_shardings
+from .spec import host_offload_supported, tree_shardings
+
+logger = logging.getLogger(__name__)
 
 
 class TrainState(struct.PyTreeNode):
@@ -82,5 +86,18 @@ def create_train_state(
         scaler=jax.tree.map(lambda _: P(), shapes.scaler),
     )
     shardings = tree_shardings(specs, mesh)
+    if policy.offload_opt_state:
+        if host_offload_supported(mesh):
+            shardings = shardings.replace(
+                opt_state=tree_shardings(
+                    specs.opt_state, mesh, memory_kind="pinned_host"
+                )
+            )
+        else:
+            logger.warning(
+                "optimizer-state host offload requested but the %s backend "
+                "has no host-placement support; keeping opt state in device "
+                "memory", mesh.devices.flat[0].platform,
+            )
     state = jax.jit(build, out_shardings=shardings)(rng)
     return state, shardings

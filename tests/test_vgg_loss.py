@@ -1,0 +1,96 @@
+"""VGG perceptual-loss parity: torch vgg16 weights -> identical features.
+
+The reference's ``feat_loss`` rides torchvision VGG-16 activations
+(`/root/reference/Stoke-DDP.py:35,224`). Proof here: build the actual torch
+``vgg16().features`` Sequential, save its state_dict, load it through
+``VGGFeatLoss.from_torch``, and check the Flax column produces the same
+activations (and hence the same loss surface) as the torch original.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from pytorch_distributedtraining_tpu.losses import VGGFeatLoss, l1_loss  # noqa: E402
+from pytorch_distributedtraining_tpu.models.vgg import (  # noqa: E402
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    RELU_TAPS,
+    _VGG16_PLAN,
+)
+
+
+def _torch_vgg16_features():
+    """torchvision vgg16 cfg-D feature column (torchvision not installed;
+    the Sequential is reconstructed to its exact layer plan + naming)."""
+    layers = []
+    cin = 3
+    for item in _VGG16_PLAN:
+        if item == "M":
+            layers.append(torch.nn.MaxPool2d(2, 2))
+        else:
+            layers.append(torch.nn.Conv2d(cin, item, 3, padding=1))
+            layers.append(torch.nn.ReLU(inplace=False))
+            cin = item
+    return torch.nn.Sequential(*layers[:-1])  # torch drops nothing; len 31
+
+
+@pytest.fixture(scope="module")
+def torch_ckpt(tmp_path_factory):
+    torch.manual_seed(0)
+    feats = _torch_vgg16_features()
+    sd = {f"features.{k}": v for k, v in feats.state_dict().items()}
+    # classifier heads present in a real vgg16 checkpoint must be ignored
+    sd["classifier.0.weight"] = torch.zeros(8, 8)
+    sd["classifier.0.bias"] = torch.zeros(8)
+    path = tmp_path_factory.mktemp("vgg") / "vgg16.pth"
+    torch.save(sd, str(path))
+    return str(path), feats
+
+
+def test_vgg_features_match_torch(torch_ckpt):
+    path, feats = torch_ckpt
+    loss = VGGFeatLoss.from_torch(path)
+
+    rng = np.random.default_rng(0)
+    x = rng.random((2, 32, 32, 3)).astype(np.float32)
+
+    ours = loss.net.apply({"params": loss.params}, jnp.asarray(x))
+
+    mean = torch.tensor(IMAGENET_MEAN).view(1, 3, 1, 1)
+    std = torch.tensor(IMAGENET_STD).view(1, 3, 1, 1)
+    xt = (torch.from_numpy(x).permute(0, 3, 1, 2) - mean) / std
+    with torch.no_grad():
+        taps = []
+        y = xt
+        for i, layer in enumerate(feats):
+            y = layer(y)
+            if i in RELU_TAPS:
+                taps.append(y.permute(0, 2, 3, 1).numpy())
+    assert len(taps) == len(ours) == len(RELU_TAPS)
+    for a, b in zip(ours, taps):
+        np.testing.assert_allclose(np.asarray(a), b, atol=2e-4)
+
+
+def test_vgg_loss_zero_on_identical_and_positive_otherwise(torch_ckpt):
+    path, _ = torch_ckpt
+    loss = VGGFeatLoss.from_torch(path)
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.random((1, 32, 32, 3)).astype(np.float32))
+    b = jnp.asarray(rng.random((1, 32, 32, 3)).astype(np.float32))
+    assert float(loss(a, a)) == pytest.approx(0.0, abs=1e-6)
+    assert float(loss(a, b)) > 0.0
+
+
+def test_vgg_loss_random_fallback_is_differentiable():
+    loss = VGGFeatLoss()  # no checkpoint: deterministic random init
+    rng = np.random.default_rng(2)
+    a = jnp.asarray(rng.random((1, 32, 32, 3)).astype(np.float32))
+    b = jnp.asarray(rng.random((1, 32, 32, 3)).astype(np.float32))
+    g = jax.grad(lambda o: loss(o, b))(a)
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.max(jnp.abs(g))) > 0.0
