@@ -32,8 +32,10 @@ from pytorch_distributedtraining_tpu.losses import FeatLoss, VGGFeatLoss, mse_lo
 from pytorch_distributedtraining_tpu.metrics import mae, psnr
 from pytorch_distributedtraining_tpu.models import Net
 
-STEPS = 300
-BATCH = 16
+import os
+
+STEPS = int(os.environ.get("GRAFT_ABLATION_STEPS", "150"))
+BATCH = int(os.environ.get("GRAFT_ABLATION_BATCH", "8"))
 HR = 32
 
 
@@ -108,6 +110,12 @@ def run_arm(name, loss_obj, train_hr, val_hr, init_params):
 
 
 def main():
+    # honor JAX_PLATFORMS=cpu even though the image's sitecustomize latches
+    # the accelerator platform before this script runs
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
     rng = np.random.default_rng(42)
     train_hr = synth_images(256, rng)
     val_hr = synth_images(64, rng)
