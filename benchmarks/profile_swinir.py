@@ -9,7 +9,9 @@ Times variants of the benched train step on the real chip in ONE process
   no_attnmm   WindowAttention's QK^T/softmax/AV replaced by identity on v
               (keeps qkv + proj Dense) -- isolates the head_dim=10 matmuls
   no_bias     attention without the relative-position-bias gather
+  bf16_softmax  attention softmax accumulated in bf16 (no f32 round-trip)
   bf16_ln     LayerNorms in bf16 instead of f32
+  all_bf16    bf16 norms + bf16 softmax together
   batch72     full step at 4x batch (occupancy check)
 
 Prints one JSON line per variant: {"variant", "ms_per_step", "img_per_sec"}.
@@ -222,37 +224,16 @@ def main():
     finally:
         swinir_mod.WindowAttention.__call__ = orig_call
 
-    # bf16 softmax (no f32 round-trip)
-    def bf16_softmax(self, x, mask=None):
-        bn, n, c = x.shape
-        h = self.num_heads
-        head_dim = c // h
-        qkv = nn.Dense(3 * c, use_bias=True, dtype=self.dtype, name="qkv")(x)
-        qkv = qkv.reshape(bn, n, 3, h, head_dim).transpose(2, 0, 3, 1, 4)
-        q, k, v = qkv[0], qkv[1], qkv[2]
-        scale = head_dim**-0.5
-        attn = (q * scale) @ k.transpose(0, 1, 3, 2)
-        table = self.param(
-            "relative_position_bias_table",
-            nn.initializers.truncated_normal(0.02),
-            ((2 * self.window_size - 1) ** 2, h),
-        )
-        idx = swinir_mod._relative_position_index(self.window_size)
-        bias = table[idx.reshape(-1)].reshape(n, n, h).transpose(2, 0, 1)
-        attn = attn + bias[None].astype(attn.dtype)
-        if mask is not None:
-            nw = mask.shape[0]
-            attn = attn.reshape(bn // nw, nw, h, n, n) + mask[None, :, None].astype(attn.dtype)
-            attn = attn.reshape(bn, h, n, n)
-        attn = jax.nn.softmax(attn, axis=-1)
-        out = (attn @ v).transpose(0, 2, 1, 3).reshape(bn, n, c)
-        return nn.Dense(c, dtype=self.dtype, name="proj")(out)
+    # bf16 softmax accumulation (no f32 round-trip on the [bn,h,n,n] probs)
+    ablate({"softmax_dtype": jnp.bfloat16}, "bf16_softmax")
 
-    swinir_mod.WindowAttention.__call__ = bf16_softmax
-    try:
-        ablate({}, "bf16_softmax")
-    finally:
-        swinir_mod.WindowAttention.__call__ = orig_call
+    # bf16 LayerNorms (halves LN HBM traffic; bandwidth-bound hypothesis)
+    ablate({"norm_dtype": jnp.bfloat16}, "bf16_ln")
+    # everything bf16: norms + softmax accumulation
+    ablate(
+        {"norm_dtype": jnp.bfloat16, "softmax_dtype": jnp.bfloat16},
+        "all_bf16",
+    )
 
     # occupancy: 4x batch through the full step
     batch72 = make_batch(4 * BATCH)

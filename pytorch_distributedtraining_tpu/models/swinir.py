@@ -99,6 +99,7 @@ class WindowAttention(nn.Module):
     num_heads: int
     window_size: int
     dtype: jnp.dtype = jnp.float32
+    softmax_dtype: jnp.dtype = jnp.float32  # attention prob accumulation
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -128,7 +129,9 @@ class WindowAttention(nn.Module):
             )
             attn = attn.reshape(bn, h, n, n)
 
-        attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(self.dtype)
+        attn = jax.nn.softmax(
+            attn.astype(self.softmax_dtype), axis=-1
+        ).astype(self.dtype)
         out = (attn @ v).transpose(0, 2, 1, 3).reshape(bn, n, c)
         return nn.Dense(c, dtype=self.dtype, name="proj")(out)
 
@@ -142,13 +145,15 @@ class SwinLayer(nn.Module):
     shift: int
     mlp_ratio: float
     dtype: jnp.dtype = jnp.float32
+    norm_dtype: jnp.dtype = jnp.float32  # LN compute/storage dtype
+    softmax_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):  # [B, H, W, C]
         b, hgt, wid, c = x.shape
         ws = self.window_size
         shortcut = x
-        y = nn.LayerNorm(dtype=jnp.float32, name="norm1")(x)
+        y = nn.LayerNorm(dtype=self.norm_dtype, name="norm1")(x)
         if self.shift > 0:
             y = jnp.roll(y, (-self.shift, -self.shift), axis=(1, 2))
             mask = jnp.asarray(_shift_attn_mask(hgt, wid, ws, self.shift))
@@ -156,14 +161,15 @@ class SwinLayer(nn.Module):
             mask = None
         wins = window_partition(y.astype(self.dtype), ws)
         wins = WindowAttention(
-            self.dim, self.num_heads, ws, dtype=self.dtype, name="attn"
+            self.dim, self.num_heads, ws, dtype=self.dtype,
+            softmax_dtype=self.softmax_dtype, name="attn",
         )(wins, mask)
         y = window_reverse(wins, ws, hgt, wid)
         if self.shift > 0:
             y = jnp.roll(y, (self.shift, self.shift), axis=(1, 2))
         x = shortcut + y.astype(shortcut.dtype)
 
-        y = nn.LayerNorm(dtype=jnp.float32, name="norm2")(x).astype(self.dtype)
+        y = nn.LayerNorm(dtype=self.norm_dtype, name="norm2")(x).astype(self.dtype)
         hdim = int(self.dim * self.mlp_ratio)
         y = nn.Dense(hdim, dtype=self.dtype, name="fc1")(y)
         y = nn.gelu(y)
@@ -180,6 +186,8 @@ class RSTB(nn.Module):
     window_size: int
     mlp_ratio: float
     dtype: jnp.dtype = jnp.float32
+    norm_dtype: jnp.dtype = jnp.float32
+    softmax_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
@@ -188,7 +196,9 @@ class RSTB(nn.Module):
             x = SwinLayer(
                 self.dim, self.num_heads, self.window_size,
                 shift=0 if i % 2 == 0 else self.window_size // 2,
-                mlp_ratio=self.mlp_ratio, dtype=self.dtype, name=f"layer_{i}",
+                mlp_ratio=self.mlp_ratio, dtype=self.dtype,
+                norm_dtype=self.norm_dtype, softmax_dtype=self.softmax_dtype,
+                name=f"layer_{i}",
             )(x)
         # resi_connection='1conv' (Stoke-DDP.py:208)
         x = nn.Conv(self.dim, (3, 3), padding="SAME", dtype=self.dtype, name="conv")(x)
@@ -210,6 +220,12 @@ class SwinIR(nn.Module):
     upsampler: str = "pixelshuffledirect"
     resi_connection: str = "1conv"
     dtype: jnp.dtype = jnp.float32
+    # LayerNorm compute/storage dtype. f32 is the safe default; bf16 halves
+    # the HBM traffic of the 50 norm applications (24 SwinLayers x 2 +
+    # patch_norm + final norm; the step is bandwidth-bound at these shapes,
+    # see benchmarks/profile_swinir.py) at ~1e-2 output tolerance.
+    norm_dtype: jnp.dtype = jnp.float32
+    softmax_dtype: jnp.dtype = jnp.float32  # attention softmax accumulation
 
     @nn.compact
     def __call__(self, x):  # [B, H, W, C] in [0, img_range]
@@ -234,15 +250,16 @@ class SwinIR(nn.Module):
         # torch SwinIR's patch_embed norm (patch_norm=True default): a
         # channel LayerNorm between shallow conv and the RSTB body — kept so
         # reference checkpoints map onto an identical function
-        y = nn.LayerNorm(dtype=jnp.float32, name="patch_norm")(feat).astype(
+        y = nn.LayerNorm(dtype=self.norm_dtype, name="patch_norm")(feat).astype(
             self.dtype
         )
         for i, (depth, heads) in enumerate(zip(self.depths, self.num_heads)):
             y = RSTB(
                 self.embed_dim, depth, heads, ws, self.mlp_ratio,
-                dtype=self.dtype, name=f"rstb_{i}",
+                dtype=self.dtype, norm_dtype=self.norm_dtype,
+                softmax_dtype=self.softmax_dtype, name=f"rstb_{i}",
             )(y)
-        y = nn.LayerNorm(dtype=jnp.float32, name="norm")(y).astype(self.dtype)
+        y = nn.LayerNorm(dtype=self.norm_dtype, name="norm")(y).astype(self.dtype)
         y = nn.Conv(
             self.embed_dim, (3, 3), padding="SAME", dtype=self.dtype,
             name="conv_after_body",

@@ -28,6 +28,16 @@ def test_stoke_driver_trains(tmp_path, capsys, monkeypatch):
     monkeypatch.setenv("WANDB_MODE", "disabled")  # never hit the network
     from drivers import stoke_ddp
 
+    # shrink the hardcoded SwinIR-S (driver parity config) to a tiny twin:
+    # full-size compile costs ~2min of 1-core CPU and tests nothing extra
+    real_swinir = stoke_ddp.SwinIR
+
+    def tiny_swinir(**kw):
+        kw.update(depths=[2], embed_dim=12, num_heads=[2])
+        return real_swinir(**kw)
+
+    monkeypatch.setattr(stoke_ddp, "SwinIR", tiny_swinir)
+
     train_loss, val_loss = stoke_ddp.main(
         ["--synthetic", "--synthetic-n", "64", "--nEpochs", "1",
          "--batchSize", "4", "--threads", "0", "--projectName", "test-proj"]

@@ -33,7 +33,9 @@ class TestResNet:
     def test_resnet50_param_count(self):
         model = ResNet50(num_classes=1000)
         x = jnp.zeros((1, 64, 64, 3))
-        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        variables = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), x, train=False)
+        )
         # canonical ResNet-50: ~25.5M
         assert 25e6 < n_params(variables["params"]) < 26e6
 
@@ -127,9 +129,9 @@ class TestViT:
                 logits, y
             ).mean()
 
-        l0, grads = jax.value_and_grad(loss_fn)(params)
+        l0, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
         tx = optax.adam(1e-3)
         opt_state = tx.init(params)
         updates, _ = tx.update(grads, opt_state, params)
-        l1 = loss_fn(optax.apply_updates(params, updates))
+        l1 = jax.jit(loss_fn)(optax.apply_updates(params, updates))
         assert float(l1) < float(l0)

@@ -23,6 +23,15 @@ def _model():
     )
 
 
+def _tiny():
+    # same code paths (2 layers = one W-MSA + one SW-MSA, conv, upsample)
+    # at a fraction of the 1-core compile time of the full SwinIR-S
+    return SwinIR(
+        upscale=2, window_size=8, depths=[2], embed_dim=12, num_heads=[2],
+        mlp_ratio=2,
+    )
+
+
 def test_window_partition_roundtrip():
     x = jnp.arange(2 * 16 * 16 * 3, dtype=jnp.float32).reshape(2, 16, 16, 3)
     wins = window_partition(x, 8)
@@ -48,16 +57,21 @@ def test_shift_mask_blocks_cross_region():
 def test_forward_shape_and_param_count():
     model = _model()
     x = jnp.zeros((1, 64, 64, 3))
-    params = model.init(jax.random.PRNGKey(0), x)["params"]
-    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    # param budget of the exact reference config, via eval_shape (no compile)
+    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0), x)["params"]
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(shapes))
     # SwinIR-S is ~0.9M params
     assert 0.7e6 < n < 1.2e6, f"param count {n}"
-    y = jax.jit(model.apply)({"params": params}, x)
-    assert y.shape == (1, 128, 128, 3)
+    # output geometry on the tiny twin (same pad/upsample code path)
+    tiny = _tiny()
+    xt = jnp.zeros((1, 16, 16, 3))
+    params = tiny.init(jax.random.PRNGKey(0), xt)["params"]
+    y = jax.jit(tiny.apply)({"params": params}, xt)
+    assert y.shape == (1, 32, 32, 3)
 
 
 def test_forward_non_multiple_of_window():
-    model = _model()
+    model = _tiny()
     x = jnp.zeros((1, 20, 28, 3))  # not multiples of 8 -> pad+crop
     params = model.init(jax.random.PRNGKey(0), x)["params"]
     y = model.apply({"params": params}, x)
@@ -66,7 +80,7 @@ def test_forward_non_multiple_of_window():
 
 def test_shift_changes_output():
     """Shifted layers must actually mix across window borders."""
-    model = _model()
+    model = _tiny()
     key = jax.random.PRNGKey(1)
     x = jax.random.uniform(key, (1, 16, 16, 3))
     params = model.init(jax.random.PRNGKey(0), x)["params"]

@@ -28,8 +28,9 @@ from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
 
 
 def _tiny_resnet():
+    # one stage is enough: BN cross-replica stats are per-layer semantics
     return ResNet(
-        stage_sizes=(1, 1),
+        stage_sizes=(1,),
         block_cls=BasicBlock,
         num_classes=4,
         num_filters=8,
