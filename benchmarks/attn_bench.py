@@ -1,0 +1,87 @@
+"""Pallas flash attention vs XLA attention on hardware (VERDICT r1 item 5).
+
+Measures forward and forward+backward wall time for the framework's Pallas
+flash-attention kernels (`ops/pallas_attn.py`) against plain XLA attention
+(`models/gpt2.default_attention`) at GPT-2-class shapes, bf16, causal.
+Flash's win is O(T) HBM traffic (no [T,T] logits round trip), so the gap
+should widen with T. One JSON line per (T, impl, pass). Results go to
+BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+
+    from pytorch_distributedtraining_tpu.models.gpt2 import default_attention
+    from pytorch_distributedtraining_tpu.ops.pallas_attn import flash_attention
+
+    B, H, D = 8, 12, 64
+    STEPS = int(os.environ.get("GRAFT_ATTN_STEPS", "20"))
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    def time_fn(fn, *args):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / STEPS
+
+    for T in (512, 1024, 2048, 4096):
+        rng = np.random.default_rng(0)
+        q, k, v = (
+            jnp.asarray(
+                rng.normal(size=(B, T, H, D)).astype(np.float32),
+                jnp.bfloat16,
+            )
+            for _ in range(3)
+        )
+
+        def xla_loss(q, k, v):
+            return jnp.sum(default_attention(q, k, v, causal=True)
+                           .astype(jnp.float32))
+
+        def flash_loss(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, True, 128, 128, not on_tpu)
+                .astype(jnp.float32)
+            )
+
+        arms = {
+            ("xla", "fwd"): jax.jit(xla_loss),
+            ("flash", "fwd"): jax.jit(flash_loss),
+            ("xla", "fwd+bwd"): jax.jit(jax.grad(xla_loss, argnums=(0, 1, 2))),
+            ("flash", "fwd+bwd"): jax.jit(
+                jax.grad(flash_loss, argnums=(0, 1, 2))
+            ),
+        }
+        for (impl, passes), fn in arms.items():
+            sec = time_fn(fn, q, k, v)
+            # attention flops: 2 matmuls * 2 flops * B*H*T^2*D (causal ~1/2)
+            flops = 2 * 2 * B * H * T * T * D * 0.5
+            if passes == "fwd+bwd":
+                flops *= 3.5  # bwd recompute + 4 grad matmuls
+            print(json.dumps({
+                "T": T, "impl": impl, "pass": passes,
+                "ms": round(sec * 1e3, 3),
+                "tflops": round(flops / sec / 1e12, 2),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
