@@ -10,7 +10,6 @@ BASELINE.md.
 
 from __future__ import annotations
 
-import functools
 import json
 import os
 import time
@@ -31,7 +30,12 @@ def main():
 
     B, H, D = 8, 12, 64
     STEPS = int(os.environ.get("GRAFT_ATTN_STEPS", "20"))
-    on_tpu = jax.devices()[0].platform == "tpu"
+    platform = jax.devices()[0].platform
+    if platform not in ("cpu", "tpu"):
+        # same guard as make_flash_attn_fn: Pallas interpret mode is not a
+        # meaningful measurement on other backends
+        raise SystemExit(f"attn_bench supports cpu/tpu, got {platform}")
+    interpret = platform != "tpu"
 
     def time_fn(fn, *args):
         out = fn(*args)
@@ -58,7 +62,7 @@ def main():
 
         def flash_loss(q, k, v):
             return jnp.sum(
-                flash_attention(q, k, v, True, 128, 128, not on_tpu)
+                flash_attention(q, k, v, True, 128, 128, interpret)
                 .astype(jnp.float32)
             )
 
@@ -75,7 +79,9 @@ def main():
             # attention flops: 2 matmuls * 2 flops * B*H*T^2*D (causal ~1/2)
             flops = 2 * 2 * B * H * T * T * D * 0.5
             if passes == "fwd+bwd":
-                flops *= 3.5  # bwd recompute + 4 grad matmuls
+                # XLA bwd reuses stored probs (~2x fwd extra); flash bwd
+                # recomputes the forward in-kernel (~2.5x fwd extra)
+                flops *= 3.0 if impl == "xla" else 3.5
             print(json.dumps({
                 "T": T, "impl": impl, "pass": passes,
                 "ms": round(sec * 1e3, 3),
