@@ -32,8 +32,8 @@ def main():
     STEPS = int(os.environ.get("GRAFT_ATTN_STEPS", "20"))
     platform = jax.devices()[0].platform
     if platform not in ("cpu", "tpu"):
-        # same guard as make_flash_attn_fn: Pallas interpret mode is not a
-        # meaningful measurement on other backends
+        # make_flash_attn_fn silently falls back to XLA attention off
+        # cpu/tpu; a benchmark must not silently measure the wrong thing
         raise SystemExit(f"attn_bench supports cpu/tpu, got {platform}")
     interpret = platform != "tpu"
 

@@ -9,10 +9,16 @@ Times variants of the benched train step on the real chip in ONE process
   no_attnmm   WindowAttention's QK^T/softmax/AV replaced by identity on v
               (keeps qkv + proj Dense) -- isolates the head_dim=10 matmuls
   no_bias     attention without the relative-position-bias gather
+  blockdiag_attn  QK^T/AV as block-diagonal-packed gemms (contraction 60
+              instead of 10) -- MXU utilization vs HBM traffic trade
   bf16_softmax  attention softmax accumulated in bf16 (no f32 round-trip)
   bf16_ln     LayerNorms in bf16 instead of f32
   all_bf16    bf16 norms + bf16 softmax together
   batch72     full step at 4x batch (occupancy check)
+
+Set GRAFT_PROFILE_TINY=1 for a CPU self-test of every arm on a tiny model
+(validates the harness; timings are not TPU-meaningful, and the analytic
+roofline line is suppressed since it describes the full-size model).
 
 Prints one JSON line per variant: {"variant", "ms_per_step", "img_per_sec"}.
 Also prints XLA's own flops estimate for the full step (cost_analysis) and
@@ -21,8 +27,8 @@ the implied MFU against v5e-class 197 TFLOP/s bf16 peak.
 
 from __future__ import annotations
 
-import functools
 import json
+import os
 import time
 
 import numpy as np
@@ -38,11 +44,16 @@ from pytorch_distributedtraining_tpu.parallel import DDP, TrainStep, create_trai
 from pytorch_distributedtraining_tpu.precision import Policy as Precision
 from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
 
-BATCH = 18
-PATCH = 64
-STEPS = 20
-WARMUP = 3
+TINY = os.environ.get("GRAFT_PROFILE_TINY") == "1"  # CPU self-test mode
+BATCH = 2 if TINY else 18
+PATCH = 16 if TINY else 64
+STEPS = 2 if TINY else 20
+WARMUP = 1 if TINY else 3
 PEAK_TFLOPS = 197.0  # v5e-class bf16
+# model kwargs shared by the main build and every ablation arm
+MODEL_KW = (
+    dict(depths=[2], embed_dim=12, num_heads=[2]) if TINY else {}
+)
 
 
 def make_batch(batch):
@@ -113,10 +124,71 @@ def report(variant, sec, batch=BATCH):
     }), flush=True)
 
 
+def analytic_model():
+    """First-principles FLOPs + HBM-bytes per image for SwinIR-S x2 @ 64x64.
+
+    Used with the measured step time to place the step on the v5e roofline
+    (compute peak ~197 TFLOP/s bf16, HBM ~819 GB/s). Activation-byte
+    counts assume XLA materializes each labeled tensor once in bf16 (norms
+    in f32) — an under-count of fusion wins and an over-count where XLA
+    fuses better; the profiler's ablation arms calibrate it.
+    """
+    C, T, WS, HEADS = 60, 64 * 64, 8, 6  # channels, tokens, window, heads
+    NW = T // (WS * WS)  # windows per image
+    N = WS * WS  # tokens per window
+    D = C // HEADS
+
+    def mm(m, k, n):  # flops of [m,k]@[k,n]
+        return 2 * m * k * n
+
+    conv_first = mm(T, 9 * 3, C)
+    per_layer = (
+        mm(T, C, 3 * C)  # qkv
+        + NW * HEADS * (mm(N, D, N) + mm(N, N, D))  # QK^T + AV
+        + mm(T, C, C)  # proj
+        + mm(T, C, 2 * C) + mm(T, 2 * C, C)  # fc1 + fc2
+    )
+    convs = 4 * mm(T, 9 * C, C) + mm(T, 9 * C, C)  # rstb convs + after_body
+    conv_up = mm(T, 9 * C, 12)
+    fwd_flops = conv_first + 24 * per_layer + convs + conv_up
+    train_flops = 3 * fwd_flops  # bwd ~2x fwd
+
+    # activation traffic per image, forward (bytes)
+    bf16, f32 = 2, 4
+    act = T * C
+    per_layer_bytes = (
+        act * f32 * 2  # norm1 out (f32 round trip)
+        + act * 3 * bf16  # qkv out
+        + NW * HEADS * N * N * (bf16 + f32)  # attn logits + f32 softmax
+        + act * bf16 * 2  # attn out + proj out
+        + act * f32 * 2  # norm2
+        + act * 2 * bf16 * 2  # fc1 out + gelu
+        + act * bf16 * 2  # fc2 out + residual
+    )
+    fwd_bytes = 24 * per_layer_bytes + 8 * act * bf16
+    train_bytes = 3 * fwd_bytes  # bwd re-reads activations + writes grads
+
+    return {
+        "analytic_fwd_gflops_per_img": round(fwd_flops / 1e9, 2),
+        "analytic_train_gflops_per_img": round(train_flops / 1e9, 2),
+        "analytic_train_mb_per_img": round(train_bytes / 1e6, 1),
+        "compute_bound_img_per_sec_at_peak": round(
+            PEAK_TFLOPS * 1e12 / train_flops, 0
+        ),
+        "bandwidth_bound_img_per_sec_at_819GBs": round(
+            819e9 / train_bytes, 0
+        ),
+    }
+
+
 def main():
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")  # sitecustomize latch
+    if not TINY:  # the analytic model describes the full-size config only
+        print(json.dumps(analytic_model()), flush=True)
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    model = SwinIR(dtype=jnp.bfloat16)
+    model = SwinIR(dtype=jnp.bfloat16, **MODEL_KW)
     batch = make_batch(BATCH)
     print(json.dumps({"stage": "built batch"}), flush=True)
     mesh, state, step, loss_fn = build_step(model, batch)
@@ -163,7 +235,7 @@ def main():
 
     # --- model ablations (fwd+bwd, same shape of loss) -------------------
     def ablate(model_cls_kwargs, name):
-        m = SwinIR(dtype=jnp.bfloat16, **model_cls_kwargs)
+        m = SwinIR(dtype=jnp.bfloat16, **MODEL_KW, **model_cls_kwargs)
         p = m.init(jax.random.PRNGKey(0), jnp.zeros((1, PATCH, PATCH, 3)))["params"]
 
         @jax.jit
@@ -175,54 +247,117 @@ def main():
 
         report(name, time_fn(fb, p, batch))
 
-    # monkeypatched attention without the attn matmuls: y = proj(qkv_v)
-    orig_call = swinir_mod.WindowAttention.__call__
+    # -- attention-variant arms: patch the module-global class (flax wraps
+    # __call__ at class creation, so assigning a raw function would lose
+    # the @nn.compact binding) --------------------------------------------
+    def with_attention(cls, name):
+        orig = swinir_mod.WindowAttention
+        swinir_mod.WindowAttention = cls
+        try:
+            ablate({}, name)
+        finally:
+            swinir_mod.WindowAttention = orig
 
-    def no_attnmm(self, x, mask=None):
-        bn, n, c = x.shape
-        h = self.num_heads
-        head_dim = c // h
-        qkv = nn.Dense(3 * c, use_bias=True, dtype=self.dtype, name="qkv")(x)
-        qkv = qkv.reshape(bn, n, 3, h, head_dim).transpose(2, 0, 3, 1, 4)
-        v = qkv[2]
-        out = v.transpose(0, 2, 1, 3).reshape(bn, n, c)
-        return nn.Dense(c, dtype=self.dtype, name="proj")(out)
+    class NoAttnMM(swinir_mod.WindowAttention):
+        """qkv + proj Dense kept; QK^T/softmax/AV replaced by identity-on-v."""
 
-    swinir_mod.WindowAttention.__call__ = no_attnmm
-    try:
-        ablate({}, "no_attnmm")
-    finally:
-        swinir_mod.WindowAttention.__call__ = orig_call
+        @nn.compact
+        def __call__(self, x, mask=None):
+            bn, n, c = x.shape
+            h = self.num_heads
+            head_dim = c // h
+            qkv = nn.Dense(3 * c, use_bias=True, dtype=self.dtype, name="qkv")(x)
+            qkv = qkv.reshape(bn, n, 3, h, head_dim).transpose(2, 0, 3, 1, 4)
+            v = qkv[2]
+            out = v.transpose(0, 2, 1, 3).reshape(bn, n, c)
+            return nn.Dense(c, dtype=self.dtype, name="proj")(out)
 
-    # attention without the relative-position-bias add
-    def no_bias(self, x, mask=None):
-        bn, n, c = x.shape
-        h = self.num_heads
-        head_dim = c // h
-        qkv = nn.Dense(3 * c, use_bias=True, dtype=self.dtype, name="qkv")(x)
-        qkv = qkv.reshape(bn, n, 3, h, head_dim).transpose(2, 0, 3, 1, 4)
-        q, k, v = qkv[0], qkv[1], qkv[2]
-        scale = head_dim**-0.5
-        attn = (q * scale) @ k.transpose(0, 1, 3, 2)
-        # keep the param so init matches; skip gather+add
-        self.param(
-            "relative_position_bias_table",
-            nn.initializers.truncated_normal(0.02),
-            ((2 * self.window_size - 1) ** 2, h),
-        )
-        if mask is not None:
-            nw = mask.shape[0]
-            attn = attn.reshape(bn // nw, nw, h, n, n) + mask[None, :, None].astype(attn.dtype)
-            attn = attn.reshape(bn, h, n, n)
-        attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(self.dtype)
-        out = (attn @ v).transpose(0, 2, 1, 3).reshape(bn, n, c)
-        return nn.Dense(c, dtype=self.dtype, name="proj")(out)
+    with_attention(NoAttnMM, "no_attnmm")
 
-    swinir_mod.WindowAttention.__call__ = no_bias
-    try:
-        ablate({}, "no_bias")
-    finally:
-        swinir_mod.WindowAttention.__call__ = orig_call
+    class NoBias(swinir_mod.WindowAttention):
+        """Full attention minus the relative-position-bias gather+add."""
+
+        @nn.compact
+        def __call__(self, x, mask=None):
+            bn, n, c = x.shape
+            h = self.num_heads
+            head_dim = c // h
+            qkv = nn.Dense(3 * c, use_bias=True, dtype=self.dtype, name="qkv")(x)
+            qkv = qkv.reshape(bn, n, 3, h, head_dim).transpose(2, 0, 3, 1, 4)
+            q, k, v = qkv[0], qkv[1], qkv[2]
+            scale = head_dim**-0.5
+            attn = (q * scale) @ k.transpose(0, 1, 3, 2)
+            # keep the param so the tree matches; skip gather+add
+            self.param(
+                "relative_position_bias_table",
+                nn.initializers.truncated_normal(0.02),
+                ((2 * self.window_size - 1) ** 2, h),
+            )
+            if mask is not None:
+                nw = mask.shape[0]
+                attn = attn.reshape(bn // nw, nw, h, n, n) + mask[
+                    None, :, None
+                ].astype(attn.dtype)
+                attn = attn.reshape(bn, h, n, n)
+            attn = jax.nn.softmax(
+                attn.astype(jnp.float32), axis=-1
+            ).astype(self.dtype)
+            out = (attn @ v).transpose(0, 2, 1, 3).reshape(bn, n, c)
+            return nn.Dense(c, dtype=self.dtype, name="proj")(out)
+
+    with_attention(NoBias, "no_bias")
+
+    class BlockdiagAttn(swinir_mod.WindowAttention):
+        """QK^T / AV as single block-diagonal-packed gemms per window:
+        contraction 60 instead of 10 (6x MXU K-utilization) at the cost of
+        materializing the packed operands (HBM traffic). Data decides."""
+
+        @nn.compact
+        def __call__(self, x, mask=None):
+            import jax.scipy.linalg as jsp
+
+            bn, n, c = x.shape
+            h = self.num_heads
+            head_dim = c // h
+            qkv = nn.Dense(3 * c, use_bias=True, dtype=self.dtype, name="qkv")(x)
+            qkv = qkv.reshape(bn, n, 3, h, head_dim).transpose(2, 0, 3, 1, 4)
+            q, k, v = qkv[0], qkv[1], qkv[2]  # [bn, h, n, d]
+            scale = head_dim**-0.5
+
+            kT = k.transpose(0, 1, 3, 2)  # [bn, h, d, n]
+            kblk = jax.vmap(
+                lambda ks: jsp.block_diag(*[ks[i] for i in range(h)])
+            )(kT)  # [bn, h*d, h*n]
+            q2 = q.transpose(0, 2, 1, 3).reshape(bn, n, h * head_dim)
+            s = (q2 * scale) @ kblk  # [bn, n, h*n]
+            attn = s.reshape(bn, n, h, n).transpose(0, 2, 1, 3)
+
+            table = self.param(
+                "relative_position_bias_table",
+                nn.initializers.truncated_normal(0.02),
+                ((2 * self.window_size - 1) ** 2, h),
+            )
+            idx = swinir_mod._relative_position_index(self.window_size)
+            bias = table[idx.reshape(-1)].reshape(n, n, h).transpose(2, 0, 1)
+            attn = attn + bias[None].astype(attn.dtype)
+            if mask is not None:
+                nw = mask.shape[0]
+                attn = attn.reshape(bn // nw, nw, h, n, n) + mask[
+                    None, :, None
+                ].astype(attn.dtype)
+                attn = attn.reshape(bn, h, n, n)
+            attn = jax.nn.softmax(
+                attn.astype(self.softmax_dtype), axis=-1
+            ).astype(self.dtype)
+
+            vblk = jax.vmap(
+                lambda vs: jsp.block_diag(*[vs[i] for i in range(h)])
+            )(v)  # [bn, h*n, h*d]
+            p2 = attn.transpose(0, 2, 1, 3).reshape(bn, n, h * n)
+            out = p2 @ vblk  # heads already concatenated
+            return nn.Dense(c, dtype=self.dtype, name="proj")(out)
+
+    with_attention(BlockdiagAttn, "blockdiag_attn")
 
     # bf16 softmax accumulation (no f32 round-trip on the [bn,h,n,n] probs)
     ablate({"softmax_dtype": jnp.bfloat16}, "bf16_softmax")
@@ -236,6 +371,8 @@ def main():
     )
 
     # occupancy: 4x batch through the full step
+    if TINY:
+        return
     batch72 = make_batch(4 * BATCH)
     mesh2, state2, step2, _ = build_step(model, batch72)
     report("batch72", time_step(mesh2, state2, step2, batch72), batch=4 * BATCH)
