@@ -179,6 +179,7 @@ def load_torch_into_template(
     key_map: dict | list | None = None,
     strict: bool = True,
     param_key: str = "params",
+    conv1d_kernels: bool = False,
 ):
     """Full torch→flax load: nesting, key renames, layout conversion.
 
@@ -187,6 +188,11 @@ def load_torch_into_template(
     explicit ``{torch_flat_key: flax_flat_key}`` dict or a
     ``[(regex, repl), ...]`` rewrite table; the :func:`default_torch_key_map`
     heuristic is applied afterwards for weight/kernel/scale twins.
+
+    ``conv1d_kernels=True`` is for checkpoints whose linear weights use the
+    HF ``Conv1D`` convention ([in, out] — GPT-2 family): they already match
+    the flax kernel layout, so the unconditional [out, in]→[in, out]
+    transpose for renamed ``weight``→``kernel`` leaves is skipped.
     Returns a params tree matching ``template``.
     """
     from .checkpoint import load_params_dict, tree_to_flat_dict
@@ -201,12 +207,22 @@ def load_torch_into_template(
         flat_src = {key_map.get(k, k): v for k, v in flat_src.items()}
     auto = default_torch_key_map(flat_src, flat_tpl)
     flat_src = {auto.get(k, k): v for k, v in flat_src.items()}
-    kernel_keys = {new for new in auto.values() if new.endswith("/kernel")}
+    kernel_keys = (
+        set()
+        if conv1d_kernels
+        else {new for new in auto.values() if new.endswith("/kernel")}
+    )
     flat_src = convert_torch_tensors(flat_src, flat_tpl, kernel_keys)
-    return load_params_dict(
+    params = load_params_dict(
         flat_dict_to_tree(flat_src), template, strict=strict,
         param_key=param_key,
     )
+    # jnp leaves, not numpy: numpy params break traced fancy-indexing
+    # (e.g. GPT-2's wpe[pos] under jit calls numpy __getitem__ on a tracer)
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(jnp.asarray, params)
 
 
 def save_torch_checkpoint(path: str, tree: dict) -> None:

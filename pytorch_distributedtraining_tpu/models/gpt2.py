@@ -27,6 +27,25 @@ import jax.numpy as jnp
 
 AttnFn = Callable[..., jnp.ndarray]  # (q, k, v, *, causal) -> out
 
+# (regex, repl) rewrites from the HF/torch GPT-2 state_dict naming onto this
+# module tree (flat "/"-joined keys; None drops torch-only buffers). HF
+# linear weights use the Conv1D [in, out] convention — load with
+# ``interop.load_torch_into_template(..., key_map=HF_KEY_MAP,
+# conv1d_kernels=True)`` so they are NOT transposed. ``lm_head`` is dropped
+# because this model ties it to ``wte`` (HF GPT2LMHeadModel ties it too).
+HF_KEY_MAP = [
+    (r"(^|/)attn/(bias|masked_bias)$", None),  # causal-mask buffers
+    (r"^lm_head/.*$", None),  # tied to wte
+    (r"^transformer/", ""),
+    (r"^h/(\d+)/attn/c_attn/", r"h_\1/c_attn/"),
+    (r"^h/(\d+)/attn/c_proj/", r"h_\1/c_proj/"),
+    (r"^h/(\d+)/mlp/c_fc/", r"h_\1/mlp_fc/"),
+    (r"^h/(\d+)/mlp/c_proj/", r"h_\1/mlp_proj/"),
+    (r"^h/(\d+)/ln_(1|2)/", r"h_\1/ln_\2/"),
+    (r"^wte/weight$", "wte"),
+    (r"^wpe/weight$", "wpe"),
+]
+
 
 @dataclass(frozen=True)
 class GPT2Config:
@@ -110,7 +129,7 @@ class Block(nn.Module):
             kernel_init=nn.initializers.normal(0.02),
         )
 
-        y = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
+        y = nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name="ln_1")(x)
         qkv = dense(3 * d, "c_attn")(y)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         reshape = lambda a: a.reshape(*a.shape[:2], h, d // h)  # noqa: E731
@@ -126,7 +145,7 @@ class Block(nn.Module):
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
         x = x + y
 
-        y = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        y = nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name="ln_2")(x)
         y = dense(cfg.mlp_ratio * d, "mlp_fc")(y)
         y = nn.gelu(y, approximate=True)
         y = dense(d, "mlp_proj")(y)
@@ -182,7 +201,7 @@ class GPT2(nn.Module):
                 x, deterministic, start_index
             )
 
-        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name="ln_f")(x)
         if cfg.tie_word_embeddings:
             logits = x @ wte.T.astype(cfg.dtype)
         else:

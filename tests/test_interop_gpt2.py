@@ -1,0 +1,87 @@
+"""HF/torch GPT-2 checkpoint interop: same weights -> same logits.
+
+Capability twin of the SwinIR pretrained-load path
+(`/root/reference/Stoke-DDP.py:209-213`) for the LM ladder family: a user's
+HF GPT-2 ``pytorch_model.bin`` state_dict loads through ``HF_KEY_MAP`` +
+``conv1d_kernels=True`` (HF Conv1D stores [in, out] — no transpose), and
+the Flax model reproduces the torch model's logits.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from pytorch_distributedtraining_tpu import interop  # noqa: E402
+from pytorch_distributedtraining_tpu.models.gpt2 import (  # noqa: E402
+    GPT2,
+    GPT2Config,
+    HF_KEY_MAP,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_pair(tmp_path_factory):
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=256, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    path = tmp_path_factory.mktemp("gpt2") / "pytorch_model.bin"
+    torch.save(hf_model.state_dict(), str(path))
+    return str(path), hf_model
+
+
+def test_hf_gpt2_state_dict_loads_and_matches_logits(hf_pair):
+    path, hf_model = hf_pair
+    cfg = GPT2Config.tiny(
+        vocab_size=256, n_positions=64, n_embd=32, n_head=2
+    )
+    model = GPT2(cfg)
+    template = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+
+    src = interop.load_torch_checkpoint(path)
+    params = interop.load_torch_into_template(
+        src, template, key_map=HF_KEY_MAP, strict=True, conv1d_kernels=True
+    )
+
+    tok = np.array([[5, 9, 2, 77, 31, 8, 100, 254]], dtype=np.int64)
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(tok)))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(tok)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)
+    # argmax prediction parity at every position
+    np.testing.assert_array_equal(
+        ours.argmax(-1), theirs.argmax(-1)
+    )
+
+
+def test_hf_gpt2_missing_key_raises(hf_pair):
+    path, _ = hf_pair
+    src = interop.load_torch_checkpoint(path)
+    cfg = GPT2Config.tiny(vocab_size=256, n_positions=64, n_embd=32, n_head=2)
+    model = GPT2(cfg)
+    template = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    from pytorch_distributedtraining_tpu.checkpoint import (
+        flat_dict_to_tree,
+        tree_to_flat_dict,
+    )
+
+    flat = tree_to_flat_dict(src)
+    key = "transformer/h/0/attn/c_attn/weight"
+    assert key in flat, sorted(flat)[:5]
+    del flat[key]
+    with pytest.raises(Exception, match="c_attn|missing"):
+        interop.load_torch_into_template(
+            flat_dict_to_tree(flat), template, key_map=HF_KEY_MAP,
+            strict=True, conv1d_kernels=True,
+        )
