@@ -18,7 +18,10 @@ from .dist import (
     device_count,
     find_free_port,
 )
-from .mesh import MeshSpec, make_mesh, best_mesh, mesh_axis_size, current_mesh
+from .mesh import (
+    MeshSpec, make_mesh, make_hybrid_mesh, best_mesh, mesh_axis_size,
+    current_mesh,
+)
 
 __all__ = [
     "initialize",
@@ -33,6 +36,7 @@ __all__ = [
     "find_free_port",
     "MeshSpec",
     "make_mesh",
+    "make_hybrid_mesh",
     "best_mesh",
     "mesh_axis_size",
     "current_mesh",

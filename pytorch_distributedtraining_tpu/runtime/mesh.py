@@ -97,6 +97,77 @@ def make_mesh(spec: MeshSpec | None = None, *, devices=None, **axes) -> Mesh:
     return Mesh(dev_array, names)
 
 
+def make_hybrid_mesh(
+    spec: MeshSpec | None = None,
+    *,
+    dcn_dp: int | None = None,
+    devices=None,
+    **axes,
+) -> Mesh:
+    """Multi-slice mesh: data parallelism over DCN, everything else on ICI.
+
+    The scaling recipe for TPU multi-pod ("ride ICI, not DCN"): put ONLY the
+    gradient all-reduce on the slow inter-slice DCN links — its volume is
+    amortized over a whole step — and keep the chatty model axes
+    (fsdp/tp/sp/ep) inside a slice on the ICI torus. ``dcn_dp`` is the
+    number of slices (defaults to ``jax.process_count()`` under one process
+    per slice); the remaining ``spec`` axes must multiply to the per-slice
+    device count.
+
+    Uses ``mesh_utils.create_hybrid_device_mesh`` on real TPU so device
+    order respects slice boundaries; on CPU/virtual devices a plain reshape
+    stands in (processes are contiguous in ``jax.devices()`` order).
+    """
+    import dataclasses
+    import warnings
+
+    if spec is None:
+        spec = MeshSpec(**axes)
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if dcn_dp is None:
+        dcn_dp = max(1, jax.process_count())
+    if spec.dp != 1:
+        raise ValueError(
+            "make_hybrid_mesh owns the dp axis (it becomes the DCN axis); "
+            "size the per-slice axes (fsdp/tp/sp/ep/pp) in the spec instead"
+        )
+    if dcn_dp * spec.size != len(devices):
+        raise ValueError(
+            f"dcn_dp={dcn_dp} x per-slice {spec.size} != {len(devices)} devices"
+        )
+    full = dataclasses.replace(spec, dp=dcn_dp)
+    if dcn_dp == 1:
+        # single slice: no DCN axis to place — delegate to the torus-aware
+        # builder (naive reshape would lose ICI ring ordering on TPU)
+        return make_mesh(full, devices=devices)
+
+    names = tuple(full.shape().keys())
+    on_tpu = devices[0].platform == "tpu"
+    has_hybrid = mesh_utils is not None and hasattr(
+        mesh_utils, "create_hybrid_device_mesh"
+    )
+    if on_tpu and has_hybrid:
+        ici_shape = tuple(1 if n == "dp" else getattr(spec, n) for n in names)
+        dcn_shape = tuple(dcn_dp if n == "dp" else 1 for n in names)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices
+        )
+        return Mesh(dev_array, names)
+    if on_tpu:  # multi-slice TPU without the slice-aware builder
+        warnings.warn(
+            "mesh_utils.create_hybrid_device_mesh unavailable: hybrid mesh "
+            "device order ignores slice boundaries — model-axis collectives "
+            "may ride DCN. Upgrade jax for the slice-aware layout."
+        )
+    # reshape with the DCN axis OUTERMOST (slices are contiguous in device
+    # order), then move it into the "dp" slot — a straight reshape would
+    # hand contiguous slices to whatever axis precedes dp (e.g. pp)
+    rest = tuple(getattr(spec, n) for n in names if n != "dp")
+    arr = np.asarray(devices).reshape((dcn_dp,) + rest)
+    arr = np.moveaxis(arr, 0, names.index("dp"))
+    return Mesh(arr, names)
+
+
 def best_mesh(n: int | None = None, *, zero: bool = False) -> Mesh:
     """The sensible default mesh: everything on one data axis."""
     spec = MeshSpec.zero(n) if zero else MeshSpec.ddp(n)

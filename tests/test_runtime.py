@@ -58,3 +58,83 @@ def test_batch_spec_covers_data_axes(devices8):
     sharded = jax.device_put(x, NamedSharding(mesh, spec))
     # batch dim is split over dp*fsdp = 8 devices
     assert sharded.addressable_shards[0].data.shape == (2, 3)
+
+
+def test_hybrid_mesh_dp_over_dcn(devices8):
+    """2 'slices' x 4-device FSDP: batch shards over dp x fsdp, state over
+    fsdp only, and a train step runs on the hybrid layout."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from pytorch_distributedtraining_tpu import optim
+    from pytorch_distributedtraining_tpu.losses import mse_loss
+    from pytorch_distributedtraining_tpu.models import Net
+    from pytorch_distributedtraining_tpu.parallel import (
+        TrainStep, ZeRO3, create_train_state,
+    )
+    from pytorch_distributedtraining_tpu.runtime.mesh import (
+        MeshSpec, data_axes, make_hybrid_mesh,
+    )
+
+    mesh = make_hybrid_mesh(
+        MeshSpec(fsdp=4), dcn_dp=2, devices=devices8
+    )
+    assert mesh.shape["dp"] == 2 and mesh.shape["fsdp"] == 4
+    assert data_axes(mesh) == ("dp", "fsdp")
+
+    model = Net(upscale_factor=2)
+    tx = optim.adamw(lr=3e-3)
+
+    def loss_fn(params, batch, rng, model_state):
+        lr_img, hr_img = batch
+        return mse_loss(model.apply({"params": params}, lr_img), hr_img), {}
+
+    state, shardings = create_train_state(
+        init_fn=lambda r: (
+            model.init(r, jnp.zeros((1, 8, 8, 3)))["params"], {},
+        ),
+        tx=tx, mesh=mesh, policy=ZeRO3(),
+    )
+    step = TrainStep(
+        loss_fn, tx, mesh, ZeRO3(), state_shardings=shardings, donate=False
+    )
+    rng = np.random.default_rng(0)
+    hr = rng.random((16, 16, 16, 3)).astype(np.float32)
+    lr = hr.reshape(16, 8, 2, 8, 2, 3).mean(axis=(2, 4))
+    losses = []
+    with mesh:
+        for _ in range(4):
+            state, m = step(state, (lr, hr))
+            losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    # params sharded over fsdp only (replicated across the DCN dp axis)
+    kernels = [x for x in jax.tree.leaves(state.params) if x.ndim == 4]
+    assert any(
+        x.addressable_shards[0].data.shape != x.shape for x in kernels
+    )
+
+
+def test_hybrid_mesh_rejects_dp_in_spec(devices8):
+    from pytorch_distributedtraining_tpu.runtime.mesh import (
+        MeshSpec, make_hybrid_mesh,
+    )
+
+    with pytest.raises(ValueError, match="owns the dp axis"):
+        make_hybrid_mesh(MeshSpec(dp=2, fsdp=4), dcn_dp=1, devices=devices8)
+
+
+def test_hybrid_mesh_fallback_keeps_slices_on_dp(devices8):
+    """Non-TPU fallback: contiguous device groups (slices) land on the dp
+    axis even when pp>1 precedes it in AXIS_ORDER."""
+    from pytorch_distributedtraining_tpu.runtime.mesh import (
+        MeshSpec, make_hybrid_mesh,
+    )
+
+    mesh = make_hybrid_mesh(
+        MeshSpec(pp=2, fsdp=2), dcn_dp=2, devices=devices8
+    )
+    arr = mesh.devices  # [pp, dp, fsdp, sp, tp, ep]
+    ids = np.vectorize(lambda d: d.id)(arr).squeeze()
+    # dp is axis 1 after squeeze -> [pp, dp, fsdp]; slice 0 = devices 0..3
+    first_slice = {int(i) for i in ids[:, 0, :].ravel()}
+    assert first_slice == {devices8[i].id for i in range(4)}, ids
