@@ -23,7 +23,7 @@ def main():
 
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+    jax.config.update("jax_compilation_cache_dir", f"/tmp/jax_bench_cache_{os.getuid()}")
 
     from pytorch_distributedtraining_tpu.models.gpt2 import default_attention
     from pytorch_distributedtraining_tpu.ops.pallas_attn import flash_attention

@@ -30,7 +30,7 @@ jax.config.update("jax_num_cpu_devices", 8)
 # Persistent compilation cache: repeated suite runs (and xdist workers after
 # the first run) skip XLA recompiles of identical programs — the single
 # biggest contributor to suite wall time (VERDICT r1 "What's weak" #4).
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_compile_cache")
+jax.config.update("jax_compilation_cache_dir", f"/tmp/jax_test_compile_cache_{os.getuid()}")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402

@@ -17,7 +17,7 @@ CHILD = """
 import os
 import jax
 
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_compile_cache")
+jax.config.update("jax_compilation_cache_dir", f"/tmp/jax_test_compile_cache_{os.getuid()}")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 from pytorch_distributedtraining_tpu.runtime import dist
