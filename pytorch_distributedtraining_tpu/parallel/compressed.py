@@ -75,8 +75,11 @@ class CompressedGradStep:
     """DDP train step whose grad all-reduce rides an int8 wire format.
 
     Opt-in sibling of ``TrainStep`` (DDP layout only): params/opt-state
-    replicated, batch sharded over the mesh's data axes. Residual state for
-    error feedback lives in ``TrainState.model_state['grad_residual']``.
+    replicated, batch sharded over the mesh's data axes. Residual state
+    for error feedback is PER-SHARD — stored with a leading dp axis
+    ``[axis_size, ...]`` sharded ``P(axis_name)`` in
+    ``TrainState.model_state['grad_residual']`` (auto-initialized on first
+    call); each shard's residual tracks its own local quantization error.
     """
 
     def __init__(
@@ -88,23 +91,34 @@ class CompressedGradStep:
         axis_name: str = "dp",
         donate: bool = False,
     ):
+        from ..runtime.mesh import data_axes
+
+        if data_axes(mesh) != (axis_name,):
+            raise ValueError(
+                f"CompressedGradStep is DDP-layout only: the mesh's data "
+                f"axes {data_axes(mesh)} must be exactly ({axis_name!r},) — "
+                "grads are synchronized over that one axis"
+            )
         self.loss_fn = loss_fn
         self.tx = tx
         self.mesh = mesh
         self.axis_name = axis_name
+        self.n_shards = mesh.shape[axis_name]
         data_sharding = NamedSharding(mesh, batch_spec(mesh))
-        replicated = NamedSharding(mesh, P())
         self._jitted = jax.jit(
             self._step,
-            in_shardings=(replicated, data_sharding),
-            out_shardings=(replicated, replicated),
             donate_argnums=(0,) if donate else (),
         )
 
     def init_residuals(self, params):
-        """Zero error-feedback residuals, one per gradient leaf."""
+        """Zero per-shard error-feedback residuals: [axis_size, ...] leaves
+        sharded over the dp axis (each shard owns its own residual)."""
+        sh = NamedSharding(self.mesh, P(self.axis_name))
         return jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params
+            lambda p: jax.device_put(
+                jnp.zeros((self.n_shards, *p.shape), jnp.float32), sh
+            ),
+            params,
         )
 
     def _step(self, state: TrainState, batch):
@@ -116,6 +130,9 @@ class CompressedGradStep:
         }
 
         def local(params, residuals, batch):
+            # residual leaves arrive as this shard's [1, ...] slice
+            residuals = jax.tree.map(lambda r: r[0], residuals)
+
             def lfn(p):
                 loss, aux = self.loss_fn(p, batch, rng, extra_state)
                 return loss, aux
@@ -128,10 +145,11 @@ class CompressedGradStep:
             grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
             grads, new_res = _compressed_mean_grads(grads, residuals, axis)
             loss = lax.pmean(loss, axis)
+            new_res = jax.tree.map(lambda r: r[None], new_res)
             return loss, grads, new_res
 
         pspec = jax.tree.map(lambda _: P(), state.params)
-        rspec = jax.tree.map(lambda _: P(), residuals)
+        rspec = jax.tree.map(lambda _: P(self.axis_name), residuals)
         bspec = jax.tree.map(lambda _: batch_spec(self.mesh), batch)
         loss, grads, new_res = jax.shard_map(
             local,
@@ -152,4 +170,11 @@ class CompressedGradStep:
         return new_state, {"loss": loss.astype(jnp.float32)}
 
     def __call__(self, state: TrainState, batch):
+        if "grad_residual" not in state.model_state:
+            state = state.replace(
+                model_state={
+                    **state.model_state,
+                    "grad_residual": self.init_residuals(state.params),
+                }
+            )
         return self._jitted(state, batch)

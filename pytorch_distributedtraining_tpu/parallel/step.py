@@ -66,6 +66,7 @@ class TrainStep:
         state_shardings: TrainState | None = None,
         extra_metrics: bool = True,
         donate: bool = True,
+        detect_anomaly: bool = False,
     ):
         self.loss_fn = loss_fn
         self.tx = tx
@@ -75,6 +76,14 @@ class TrainStep:
         self.precision = precision or PrecisionPolicy()
         self.loss_scaler = loss_scaler
         self.extra_metrics = extra_metrics
+        # torch.autograd.set_detect_anomaly twin: raise with the offending
+        # param paths the step a non-finite gradient appears (debug mode —
+        # the host callback costs a device sync per step). Forces
+        # donate=False so the pre-step state survives for inspection when
+        # the (possibly async) callback error surfaces.
+        self.detect_anomaly = detect_anomaly
+        if detect_anomaly:
+            donate = False
 
         data_sharding = NamedSharding(mesh, batch_spec(mesh))
         # pytree-prefix semantics: one sharding covers every batch leaf
@@ -152,6 +161,14 @@ class TrainStep:
         else:
             grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
 
+        if self.detect_anomaly:
+            # after unscale; with a loss scaler active only NaN is anomalous
+            # (inf overflows are the scaler's own backoff-and-skip path —
+            # torch's set_detect_anomaly likewise flags NaN only)
+            self._check_finite(
+                grads, loss, nan_only=self.loss_scaler is not None
+            )
+
         # ZeRO-2/3: force reduce-scatter layout on grads
         gspecs = self.policy.grads_specs(state.params, self.mesh)
         if gspecs is not None:
@@ -188,6 +205,39 @@ class TrainStep:
             scaler=new_scaler if new_scaler is not None else state.scaler,
         )
         return new_state, metrics
+
+    def _check_finite(self, grads, loss, nan_only: bool = False):
+        """In-jit anomaly check: host callback raises naming bad leaves.
+
+        The raise travels through ``jax.debug.callback``, so on async
+        backends it surfaces at the next sync point (possibly wrapped in an
+        XlaRuntimeError) — debug-mode semantics; donation is disabled so
+        the caller's pre-step state stays inspectable.
+        """
+        ok = (
+            (lambda v: jnp.logical_not(jnp.any(jnp.isnan(v))))
+            if nan_only
+            else (lambda v: jnp.all(jnp.isfinite(v)))
+        )
+        paths = [
+            jax.tree_util.keystr(path)
+            for path, _ in jax.tree_util.tree_flatten_with_path(grads)[0]
+        ]
+        flags = jnp.asarray([ok(v) for v in jax.tree.leaves(grads)])
+        loss_ok = ok(loss)
+
+        def raise_on_bad(flags_host, loss_ok_host):
+            bad = [p for p, ok in zip(paths, flags_host) if not ok]
+            if not loss_ok_host:
+                bad = ["<loss>"] + bad
+            if bad:
+                raise FloatingPointError(
+                    "detect_anomaly: non-finite values in "
+                    + ", ".join(bad[:8])
+                    + (" ..." if len(bad) > 8 else "")
+                )
+
+        jax.debug.callback(raise_on_bad, flags, loss_ok)
 
     def __call__(self, state: TrainState, batch, lr_factor: float = 1.0):
         return self._jitted(state, batch, jnp.float32(lr_factor))

@@ -77,9 +77,15 @@ def test_compressed_tracks_exact_ddp(devices8):
     np.testing.assert_allclose(
         float(m_c["loss"]), float(m_e["loss"]), rtol=0.15
     )
-    # error-feedback residuals are live (quantization actually happened)
+    # error-feedback residuals are live (quantization actually happened),
+    # carry a true per-shard layout, and survive materialization round trips
     res = jax.tree.leaves(s_c.model_state["grad_residual"])
     assert any(float(jnp.max(jnp.abs(r))) > 0 for r in res)
+    r0 = res[0]
+    assert r0.shape[0] == 8  # leading dp axis
+    assert r0.sharding.spec[0] == "dp"
+    host = np.asarray(r0)  # materialize: per-shard values must be distinct
+    assert host.shape == r0.shape
 
 
 def test_quantize_roundtrip_unbiased_over_steps():

@@ -220,3 +220,45 @@ def test_eval_step_respects_policy_shardings(devices8):
     np.testing.assert_allclose(
         float(metrics["val_loss"]), float(ref["val_loss"]), rtol=2e-5
     )
+
+
+def test_detect_anomaly_raises_on_nan_grads(mesh8):
+    """torch.autograd.set_detect_anomaly twin: non-finite grads raise with
+    the offending leaf paths; without the flag NaNs propagate silently."""
+    model = Net(upscale_factor=2)
+    tx = optim.adamw(lr=0.01)
+
+    def bad_loss(params, batch, rng, model_state):
+        lr_img, hr_img = batch
+        out = model.apply({"params": params}, lr_img)
+        # 0/0 -> NaN loss -> NaN grads
+        z = jnp.sum(out) * 0.0
+        return mse_loss(out, hr_img) + z / z, {}
+
+    state, shardings = create_train_state(
+        init_fn=lambda rng: (
+            model.init(rng, jnp.zeros((1, 8, 8, 3)))["params"], {},
+        ),
+        tx=tx, mesh=mesh8, policy=DDP(),
+    )
+    step = TrainStep(
+        bad_loss, tx, mesh8, DDP(), state_shardings=shardings,
+        donate=False, detect_anomaly=True,
+    )
+    batch = _batch(16)
+    with pytest.raises(Exception, match="detect_anomaly|non-finite"):
+        with mesh8:
+            state, m = step(state, batch)
+            jax.block_until_ready(m["loss"])
+
+
+def test_detect_anomaly_quiet_on_healthy_grads(mesh8):
+    state, step = _make(mesh8)
+    step_anom = TrainStep(
+        step.loss_fn, step.tx, mesh8, DDP(),
+        state_shardings=None, donate=False, detect_anomaly=True,
+    )
+    with mesh8:
+        state, m = step_anom(state, _batch(16))
+        jax.block_until_ready(m["loss"])
+    assert np.isfinite(float(m["loss"]))
