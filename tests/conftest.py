@@ -33,6 +33,10 @@ jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_compilation_cache_dir", f"/tmp/jax_test_compile_cache_{os.getuid()}")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
+# Tests exercise correctness, not runtime speed: skipping XLA's optimization
+# pipeline cuts compile time (the dominant suite cost on this 1-core box).
+jax.config.update("jax_disable_most_optimizations", True)
+
 import pytest  # noqa: E402
 
 

@@ -97,20 +97,24 @@ def test_quantize_roundtrip_unbiased_over_steps():
         g = jnp.asarray(
             np.random.default_rng(3).normal(size=(64,)).astype(np.float32)
         ) * 1e-3
-        r = jnp.zeros_like(g)
-        acc = jnp.zeros_like(g)
-        for _ in range(20):
+
+        def body(carry, _):
+            r, acc = carry
             q, scale, r = _quantize(g, r, axis_name)
-            acc = acc + q.astype(jnp.float32) * scale
+            return (r, acc + q.astype(jnp.float32) * scale), None
+
+        (r, acc), _ = jax.lax.scan(
+            body, (jnp.zeros_like(g), jnp.zeros_like(g)), None, length=20
+        )
         return acc / 20.0, g
 
     mesh = make_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
     from jax.sharding import PartitionSpec as P
 
-    acc, g = jax.shard_map(
+    acc, g = jax.jit(jax.shard_map(
         lambda: run(), mesh=mesh, in_specs=(), out_specs=(P(), P()),
         check_vma=False,
-    )()
+    ))()
     np.testing.assert_allclose(np.asarray(acc), np.asarray(g), atol=1e-6)
 
 
