@@ -225,6 +225,76 @@ def load_torch_into_template(
     return jax.tree.map(jnp.asarray, params)
 
 
+def torch_swinir_state_dict(params, *, model=None) -> dict:
+    """SwinIR params -> official torch-SwinIR state_dict (torch tensors).
+
+    Inverse of the ``TORCH_KEY_MAP`` load path: flat framework keys become
+    ``layers.N.residual_group.blocks.M.*`` names via
+    ``models.swinir.SWINIR_EXPORT_KEY_MAP``; ONLY kernel leaves change
+    layout (HWIO conv -> OIHW, [in,out] linear -> [out,in]) — non-kernel
+    2-d leaves like ``relative_position_bias_table`` keep their shape,
+    which is already the official one.
+
+    Pass the ``model`` (a :class:`~..models.swinir.SwinIR` instance) to
+    also emit the registered buffers torch's ``load_state_dict(strict=
+    True)`` expects (``relative_position_index`` per block, ``attn_mask``
+    on shifted blocks at the model's training ``img_size``).
+    """
+    import re
+
+    import jax
+    import torch
+
+    from .checkpoint import tree_to_flat_dict
+    from .models.swinir import SWINIR_EXPORT_KEY_MAP
+
+    def to_torch_name(k: str) -> str:
+        for pat, repl in SWINIR_EXPORT_KEY_MAP:
+            k = re.sub(pat, repl, k)
+        k = k.replace("/", ".")
+        return re.sub(r"\.(kernel|scale)$", ".weight", k)
+
+    sd = {}
+    for k, v in tree_to_flat_dict(jax.device_get(params)).items():
+        a = np.asarray(v)
+        if k.endswith("/kernel"):
+            if a.ndim == 4:
+                a = np.transpose(a, (3, 2, 0, 1))  # HWIO -> OIHW
+            elif a.ndim == 2:
+                a = a.T  # [in, out] -> [out, in]
+        sd[to_torch_name(k)] = torch.from_numpy(np.array(a, copy=True))
+
+    if model is not None:
+        from .models.swinir import (
+            _relative_position_index,
+            _shift_attn_mask,
+        )
+
+        ws = model.window_size
+        hw = model.img_size
+        idx = torch.from_numpy(_relative_position_index(ws)).long()
+        mask = torch.from_numpy(_shift_attn_mask(hw, hw, ws, ws // 2))
+        for i, depth in enumerate(model.depths):
+            for j in range(depth):
+                base = f"layers.{i}.residual_group.blocks.{j}"
+                sd[f"{base}.attn.relative_position_index"] = idx.clone()
+                if j % 2 == 1:  # shifted blocks carry the trained-size mask
+                    sd[f"{base}.attn_mask"] = mask.clone()
+    return sd
+
+
+def save_torch_swinir(
+    path: str, params, *, model=None, param_key: str = "params"
+) -> None:
+    """Write :func:`torch_swinir_state_dict` nested under ``'params'`` —
+    the exact file shape the reference loads (`Stoke-DDP.py:209-213`), so a
+    model trained here drops back into the torch ecosystem."""
+    import torch
+
+    sd = torch_swinir_state_dict(params, model=model)
+    torch.save({param_key: sd} if param_key else sd, path)
+
+
 def save_torch_checkpoint(path: str, tree: dict) -> None:
     """Write a framework pytree as a torch-loadable ``.pth`` (reverse path:
     lets reference users consume checkpoints trained here)."""

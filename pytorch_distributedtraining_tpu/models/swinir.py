@@ -93,6 +93,20 @@ TORCH_KEY_MAP = [
     (r"^upsample/0/", "conv_up/"),  # UpsampleOneStep = Sequential(Conv, PS)
 ]
 
+# Inverse direction (export): framework flat keys -> official torch names.
+# Kept next to TORCH_KEY_MAP so the two directions evolve together; the
+# leaf twins (kernel->weight + layout) are handled by interop's exporter.
+SWINIR_EXPORT_KEY_MAP = [
+    # leaf-module renames FIRST: later rules rewrite the "/" separators
+    # these patterns anchor on
+    (r"/fc1/", "/mlp.fc1/"),
+    (r"/fc2/", "/mlp.fc2/"),
+    (r"^rstb_(\d+)/layer_(\d+)/", r"layers.\1.residual_group.blocks.\2."),
+    (r"^rstb_(\d+)/conv/", r"layers.\1.conv."),
+    (r"^patch_norm/", "patch_embed.norm."),
+    (r"^conv_up/", "upsample.0."),
+]
+
 
 class WindowAttention(nn.Module):
     dim: int

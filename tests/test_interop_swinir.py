@@ -26,41 +26,13 @@ CFG = dict(
 )
 
 
-def _to_torch_name(flat_key: str) -> str:
-    """Inverse of TORCH_KEY_MAP + leaf twins: our flat key -> torch key."""
-    k = flat_key
-    k = re.sub(r"^rstb_(\d+)/layer_(\d+)/", r"layers.\1.residual_group.blocks.\2.", k)
-    k = re.sub(r"^rstb_(\d+)/conv/", r"layers.\1.conv.", k)
-    k = re.sub(r"^patch_norm/", "patch_embed.norm.", k)
-    k = re.sub(r"^conv_up/", "upsample.0.", k)
-    k = k.replace("/fc1/", "/mlp.fc1.").replace("/fc2/", "/mlp.fc2.")
-    k = k.replace("/", ".")
-    k = re.sub(r"\.(kernel|scale)$", ".weight", k)
-    return k
-
-
-def _to_torch_layout(a: np.ndarray) -> np.ndarray:
-    if a.ndim == 4:
-        return np.transpose(a, (3, 2, 0, 1))  # HWIO -> OIHW
-    if a.ndim == 2:
-        return a.T  # [in,out] -> [out,in]
-    return a
-
-
 def _torch_swinir_state_dict(params) -> dict:
-    sd = {}
-    for k, v in tree_to_flat_dict(jax.device_get(params)).items():
-        sd[_to_torch_name(k)] = torch.from_numpy(
-            np.array(_to_torch_layout(np.asarray(v)), copy=True)
-        )
-    # torch-only registered buffers present in real checkpoints; the loader
-    # must drop them under strict=True
-    n = CFG["window_size"] ** 2
-    sd["layers.0.residual_group.blocks.0.attn.relative_position_index"] = (
-        torch.zeros(n, n, dtype=torch.long)
-    )
-    sd["layers.0.residual_group.blocks.1.attn_mask"] = torch.zeros(4, n, n)
-    return sd
+    """Production exporter incl. the torch-only registered buffers the
+    loader must drop under strict=True (single source of truth in
+    interop.torch_swinir_state_dict)."""
+    from pytorch_distributedtraining_tpu import interop
+
+    return interop.torch_swinir_state_dict(params, model=SwinIR(**CFG))
 
 
 def test_torch_swinir_checkpoint_strict_load(tmp_path):
@@ -113,13 +85,15 @@ def test_torch_swinir_missing_key_raises(tmp_path):
 def test_key_map_covers_every_param():
     """Every param leaf has a torch twin that maps back through
     TORCH_KEY_MAP — no silent unmapped keys in either direction."""
+    from pytorch_distributedtraining_tpu import interop
     from pytorch_distributedtraining_tpu.interop import rewrite_keys
 
     model = SwinIR(**CFG)
     x = np.zeros((1, 8, 8, 3), np.float32)
     params = model.init(jax.random.PRNGKey(0), x)["params"]
     flat = tree_to_flat_dict(jax.device_get(params))
-    torch_keys = {_to_torch_name(k): None for k in flat}
+    # params-only export (no buffers) gives the name map under test
+    torch_keys = dict.fromkeys(interop.torch_swinir_state_dict(params))
     back = rewrite_keys(
         {k.replace(".", "/"): None for k in torch_keys}, TORCH_KEY_MAP
     )
@@ -128,3 +102,47 @@ def test_key_map_covers_every_param():
     ours = {k.rpartition("/")[0] for k in flat}
     theirs = {k.rpartition("/")[0] for k in back}
     assert ours == theirs
+
+
+def test_export_round_trip_through_torch_format(tmp_path):
+    """Train-here -> save_torch_swinir -> strict reference-style load
+    reproduces the exported model exactly (bidirectional interop)."""
+    from pytorch_distributedtraining_tpu import interop
+
+    model = SwinIR(**CFG)
+    x = np.random.default_rng(5).random((8, 8, 8, 3)).astype(np.float32)
+    params = model.init(jax.random.PRNGKey(3), x[:1])["params"]
+    ref_out = model.apply({"params": params}, x)
+
+    path = str(tmp_path / "exported_swinir_x2.pth")
+    interop.save_torch_swinir(path, params, model=model)
+
+    # torch-side strict-load expectations: registered buffers present,
+    # bias table in the official (untransposed) layout
+    sd = torch.load(path, weights_only=True)["params"]
+    n = CFG["window_size"] ** 2
+    assert sd[
+        "layers.0.residual_group.blocks.0.attn.relative_position_index"
+    ].shape == (n, n)
+    assert sd["layers.0.residual_group.blocks.1.attn_mask"].shape[1:] == (n, n)
+    table = sd[
+        "layers.0.residual_group.blocks.0.attn.relative_position_bias_table"
+    ]
+    assert table.shape == ((2 * CFG["window_size"] - 1) ** 2, CFG["num_heads"][0])
+    # official MLP naming (regression: the fc rules must fire before the
+    # block rewrite consumes the "/" separators)
+    assert "layers.1.residual_group.blocks.1.mlp.fc2.weight" in sd
+
+    # load it back the way the reference user would (facade, strict)
+    s = Stoke(
+        model=SwinIR(**CFG),
+        optimizer=StokeOptimizer(optimizer="AdamW", optimizer_kwargs={"lr": 1e-3}),
+        loss=losses.mse_loss,
+        sample_input=x,
+        rng_seed=11,
+    )
+    s.load_model_state(path, strict=True)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(s.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    out = np.asarray(s.model(x))
+    np.testing.assert_allclose(out, np.asarray(ref_out), atol=2e-5)
