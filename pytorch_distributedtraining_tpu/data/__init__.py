@@ -8,13 +8,17 @@ step), and the loader feeds `jax.device_put` with a mesh sharding instead of
 pinned-memory H2D copies.
 """
 
-from .dataset import Dataset, CustomDataset, SyntheticSRDataset, TensorDataset, random_split
+from .dataset import (
+    Dataset, CustomDataset, PatchStore, SyntheticSRDataset, TensorDataset,
+    random_split,
+)
 from .sampler import DistributedSampler
 from .loader import DataLoader
 
 __all__ = [
     "Dataset",
     "CustomDataset",
+    "PatchStore",
     "SyntheticSRDataset",
     "TensorDataset",
     "random_split",

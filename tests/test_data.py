@@ -201,3 +201,40 @@ def test_loader_explicit_set_epoch_resets_auto_counter():
     e5 = next(iter(dl))[0].tolist()
     dl.set_epoch(5)
     assert next(iter(dl))[0].tolist() == e5  # deterministic resume
+
+
+def test_patch_store_build_and_matches_custom_dataset(tmp_path):
+    """PatchStore.build decodes a CustomDataset folder pair once; samples
+    then match the PIL path to u8 quantization and feed decode-free."""
+    from PIL import Image
+
+    from pytorch_distributedtraining_tpu.data import CustomDataset, PatchStore
+
+    lr_dir, hr_dir = tmp_path / "lr", tmp_path / "hr"
+    lr_dir.mkdir(), hr_dir.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(6):
+        hr = (rng.random((16, 16, 3)) * 255).astype(np.uint8)
+        lr = hr.reshape(8, 2, 8, 2, 3).mean(axis=(1, 3)).astype(np.uint8)
+        Image.fromarray(hr).save(hr_dir / f"{i:03d}.png")
+        Image.fromarray(lr).save(lr_dir / f"{i:03d}.png")
+
+    store = PatchStore.build(str(lr_dir), str(hr_dir), str(tmp_path / "store"))
+    ref = CustomDataset(str(lr_dir), str(hr_dir))
+    assert len(store) == len(ref) == 6
+    for i in (0, 3, 5):
+        (sl, sh), (rl, rh) = store[i], ref[i]
+        assert sl.dtype == np.float32 and sh.dtype == np.float32
+        np.testing.assert_allclose(sl, rl, atol=1 / 254)
+        np.testing.assert_allclose(sh, rh, atol=1 / 254)
+
+    # reopening from disk (memmap) works without rebuild
+    store2 = PatchStore(str(tmp_path / "store"))
+    np.testing.assert_array_equal(store2[2][1], store[2][1])
+
+
+def test_patch_store_missing_dir_raises(tmp_path):
+    from pytorch_distributedtraining_tpu.data import PatchStore
+
+    with pytest.raises(FileNotFoundError, match="patch store"):
+        PatchStore(str(tmp_path / "nope"))
