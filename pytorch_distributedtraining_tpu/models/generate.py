@@ -4,7 +4,7 @@ Inference capability beyond the reference's training-only surface: chunked
 prompt prefill into the Block KV caches (models/gpt2.py ``decode=True``),
 then one `lax.scan` over single-token steps — the whole decode loop is one
 compiled XLA program, cache updates are in-place dynamic slices, and
-sampling (greedy / temperature / top-k) is branchless.
+sampling (greedy / temperature / top-k / top-p nucleus) is branchless.
 """
 
 from __future__ import annotations
@@ -16,14 +16,38 @@ import jax.numpy as jnp
 
 
 def sample_logits(logits, rng, *, temperature: float = 1.0,
-                  top_k: Optional[int] = None):
-    """[B, V] logits -> [B] token ids. temperature=0 → greedy."""
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None):
+    """[B, V] logits -> [B] token ids. temperature=0 → greedy.
+
+    ``top_k`` keeps the k highest logits; ``top_p`` (nucleus) keeps the
+    smallest prefix of the sorted distribution whose mass reaches p. Both
+    filters compose (top-k first).
+    """
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
-    if top_k is not None and top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    want_k = top_k is not None and top_k > 0
+    want_p = top_p is not None and top_p < 1.0
+    if want_k or want_p:
+        # one descending sort serves both filters
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        v = logits.shape[-1]
+        rank = jnp.arange(v)[None, :]
+        if want_k:
+            kth = sorted_desc[:, top_k - 1][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+            sorted_desc = jnp.where(rank < top_k, sorted_desc, -jnp.inf)
+        if want_p:
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep tokens while the mass BEFORE them is < p; the argmax is
+            # always kept (top_p <= 0 degenerates to greedy, not garbage)
+            keep = jnp.logical_or(cum - probs < top_p, rank == 0)
+            cutoff = jnp.min(
+                jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+            )
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1)
 
 
@@ -53,6 +77,7 @@ def generate(
     rng=None,
     temperature: float = 1.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ):
     """Returns [B, T_prompt + max_new_tokens] tokens (prompt included).
 
@@ -78,7 +103,8 @@ def generate(
     cache = mutated["cache"]
     rng, sub = jax.random.split(rng)
     next_tok = sample_logits(
-        logits[:, -1], sub, temperature=temperature, top_k=top_k
+        logits[:, -1], sub, temperature=temperature, top_k=top_k,
+        top_p=top_p,
     )
 
     def step(carry, step_rng):
@@ -88,7 +114,8 @@ def generate(
             mutable=["cache"],
         )
         nxt = sample_logits(
-            logits[:, -1], step_rng, temperature=temperature, top_k=top_k
+            logits[:, -1], step_rng, temperature=temperature, top_k=top_k,
+            top_p=top_p,
         )
         return (mutated["cache"], nxt), tok
 

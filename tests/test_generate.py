@@ -106,3 +106,50 @@ class TestGenerate:
         prompt = jnp.zeros((1, 60), jnp.int32)
         with pytest.raises(ValueError, match="exceeds"):
             generate(model, params, prompt, 8)
+
+
+class TestTopP:
+    def test_top_p_masks_tail(self):
+        # probs ~ [0.643, 0.236, 0.087, 0.032]: top_p=0.7 keeps tokens 3,2
+        logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0]])
+        for seed in range(10):
+            tok = sample_logits(
+                logits, jax.random.PRNGKey(seed), temperature=1.0, top_p=0.7
+            )
+            assert int(tok[0]) in (2, 3)
+
+    def test_top_p_one_keeps_everything(self):
+        logits = jnp.asarray([[0.0, 0.0, 0.0, 0.0]])
+        seen = {
+            int(sample_logits(
+                logits, jax.random.PRNGKey(s), temperature=1.0, top_p=1.0
+            )[0])
+            for s in range(40)
+        }
+        assert len(seen) >= 3  # all tokens reachable
+
+    def test_top_p_tiny_p_is_greedy(self):
+        logits = jnp.asarray([[0.1, 2.0, 0.3, 0.2]])
+        for seed in range(5):
+            tok = sample_logits(
+                logits, jax.random.PRNGKey(seed), temperature=1.0, top_p=1e-6
+            )
+            assert int(tok[0]) == 1  # only the argmax survives
+
+    def test_top_p_zero_is_greedy_not_token_zero(self):
+        logits = jnp.asarray([[0.1, 2.0, 0.3, 0.2]])
+        for seed in range(5):
+            tok = sample_logits(
+                logits, jax.random.PRNGKey(seed), temperature=1.0, top_p=0.0
+            )
+            assert int(tok[0]) == 1
+
+    def test_top_k_and_top_p_compose(self):
+        # top_k=3 drops token 0; top_p over the renormalized top-3 keeps 3,2
+        logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0]])
+        for seed in range(10):
+            tok = sample_logits(
+                logits, jax.random.PRNGKey(seed), temperature=1.0,
+                top_k=3, top_p=0.75,
+            )
+            assert int(tok[0]) in (2, 3)
