@@ -10,86 +10,205 @@ trained; an A100 at ~50% bf16 utilization (~150 TFLOP/s) gives ~7000
 img/s, derated to 6000 for data/optimizer overhead. The ratio is the
 trackable cross-round number; BASELINE.json's north star asks for >=0.70.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON result line: {"metric", "value", "unit", "vs_baseline"}.
+Progress lines prefixed with ``# `` are streamed (unbuffered) as the run
+proceeds so a driver-side kill can never observe an empty output tail.
+
+Failure envelope (the round-2 artifact was rc=124 with an *empty* tail
+because the old parent buffered everything and its worst-case budget was
+~46 min): the parent now enforces a hard self-deadline (default 330 s,
+well under any plausible driver timeout), probes TPU backend init with a
+short bound before spending real time, streams every child line the moment
+it appears, and converts SIGTERM/SIGALRM/budget-expiry into the structured
+error record. The only terminal states are rc=0 with a value>0 record or
+rc=1 with an error record — never silence.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
+import threading
 import time
 
 BASELINE_IMG_PER_SEC = 6000.0  # per-chip A100-class estimate; see docstring
-BATCH = int(os.environ.get("GRAFT_BENCH_BATCH", "18"))  # Stoke-DDP.py:159
+BATCH = max(1, int(os.environ.get("GRAFT_BENCH_BATCH", "18")))  # Stoke-DDP.py:159
 PATCH = 64  # Stoke-DDP.py:207 img_size
-STEPS = int(os.environ.get("GRAFT_BENCH_STEPS", "20"))
-WARMUP = int(os.environ.get("GRAFT_BENCH_WARMUP", "3"))
+STEPS = max(1, int(os.environ.get("GRAFT_BENCH_STEPS", "20")))
+WARMUP = max(1, int(os.environ.get("GRAFT_BENCH_WARMUP", "3")))
 
 METRIC = "swinir_s_x2_train_images_per_sec_per_chip"
 UNIT = "images/sec/chip"
-ATTEMPTS = int(os.environ.get("GRAFT_BENCH_ATTEMPTS", "3"))  # TPU init is flaky
-ATTEMPT_TIMEOUT_S = int(os.environ.get("GRAFT_BENCH_TIMEOUT", "900"))
-RETRY_BACKOFF_S = int(os.environ.get("GRAFT_BENCH_BACKOFF", "20"))
+
+# Budget envelope. Total self-deadline stays far under any driver timeout;
+# within it: one short backend probe, then up to ATTEMPTS bench children.
+TOTAL_BUDGET_S = int(os.environ.get("GRAFT_BENCH_TOTAL", "330"))
+PROBE_TIMEOUT_S = int(os.environ.get("GRAFT_BENCH_PROBE", "70"))
+ATTEMPTS = int(os.environ.get("GRAFT_BENCH_ATTEMPTS", "2"))
+# 0 = no per-attempt cap (each attempt may use the whole remaining clock)
+ATTEMPT_TIMEOUT_S = int(os.environ.get("GRAFT_BENCH_TIMEOUT", "0"))
+RETRY_BACKOFF_S = int(os.environ.get("GRAFT_BENCH_BACKOFF", "5"))
+COMPILE_CACHE_DIR = os.environ.get(
+    "GRAFT_BENCH_CACHE", "/tmp/graft_jax_compile_cache"
+)
+
+_DEADLINE = time.monotonic() + TOTAL_BUDGET_S
+# Emit/exit state is only touched from the main thread and its signal
+# handlers, which cannot interleave with each other mid-handler — a plain
+# flag is correct where a non-reentrant lock could self-deadlock (a handler
+# firing while the main thread holds the lock would block forever).
+_DONE = False
+_CHILD: subprocess.Popen | None = None
 
 
-def main() -> None:
-    """Run the bench in a child process with bounded retries.
+def _status(msg: str) -> None:
+    """Stream a progress line immediately; the output tail is never empty."""
+    sys.stdout.write(f"# {time.strftime('%H:%M:%S')} {msg}\n")
+    sys.stdout.flush()
 
-    Round 1's official artifact was a bare ``JaxRuntimeError: UNAVAILABLE``
-    stack trace from TPU backend init (`BENCH_r01.json` rc=1), and the
-    backend can also *hang* rather than fail, which no in-process
-    try/except survives. So the parent re-execs itself as a child with a
-    hard timeout and retries; the only things it ever prints are the
-    child's one JSON result line or a one-line JSON error record.
+
+def _killpg(proc: subprocess.Popen) -> None:
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        proc.kill()
+
+
+def _kill_child() -> None:
+    """Kill the live child's whole process group, if any.
+
+    Without this, a signal-path exit would orphan a bench child that keeps
+    holding the TPU claim (start_new_session detaches it from the driver's
+    group), poisoning the next run with the very hung-backend failure this
+    envelope exists to avoid.
     """
-    if os.environ.get("_GRAFT_BENCH_CHILD") == "1":
-        _bench()
+    proc = _CHILD
+    if proc is None or proc.poll() is not None:
         return
-    err = "unknown"
-    for attempt in range(1, ATTEMPTS + 1):
-        env = dict(os.environ)
-        env["_GRAFT_BENCH_CHILD"] = "1"
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-u", os.path.abspath(__file__)],
-                env=env,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-                capture_output=True,
-                text=True,
-                timeout=ATTEMPT_TIMEOUT_S,
-            )
-        except subprocess.TimeoutExpired:
-            err = f"attempt {attempt}: timed out after {ATTEMPT_TIMEOUT_S}s"
-            continue
-        result = _extract_json_line(proc.stdout)
-        if proc.returncode == 0 and result is not None:
-            print(result)
-            return
-        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-        err = f"attempt {attempt} rc={proc.returncode}: " + (
-            tail[-1][:300] if tail else "no output"
-        )
-        if attempt < ATTEMPTS:
-            time.sleep(RETRY_BACKOFF_S)
-    print(
-        json.dumps(
-            {
-                "metric": METRIC,
-                "value": 0.0,
-                "unit": UNIT,
-                "vs_baseline": 0.0,
-                "error": f"TPU bench failed after {ATTEMPTS} attempts: {err}",
-            }
-        )
+    _killpg(proc)
+
+
+def _emit_error(reason: str) -> None:
+    """Print the structured error record exactly once and exit rc=1.
+
+    Runs from signal handlers too, possibly while the main thread is mid
+    sys.stdout.write — so the record goes out via os.write(1, ...), the
+    async-signal-safe path that cannot raise the BufferedWriter reentrancy
+    error (which would die with an empty stdout tail, the exact round-2
+    failure this envelope exists to prevent).
+    """
+    global _DONE
+    if _DONE:
+        return
+    _DONE = True
+    _kill_child()
+    payload = json.dumps(
+        {
+            "metric": METRIC,
+            "value": 0.0,
+            "unit": UNIT,
+            "vs_baseline": 0.0,
+            "error": reason[:500],
+        }
     )
-    sys.exit(1)
+    os.write(1, ("\n" + payload + "\n").encode())
+    os._exit(1)
 
 
-def _extract_json_line(stdout: str) -> str | None:
-    """Last stdout line that parses as the result record, if any."""
-    for line in reversed((stdout or "").strip().splitlines()):
+def _emit_result(line: str) -> None:
+    global _DONE
+    if _DONE:
+        return
+    _DONE = True
+    os.write(1, ("\n" + line + "\n").encode())
+    os._exit(0)
+
+
+def _remaining() -> float:
+    return _DEADLINE - time.monotonic()
+
+
+def _run_child(
+    extra_env: dict, timeout_s: float
+) -> tuple[int | None, list[str], list[str]]:
+    """Run this file as a child, streaming its output live.
+
+    Returns (returncode, stdout_lines, diag_lines). returncode None means
+    killed on timeout. stderr is pumped on its own pipe (streamed + kept
+    for diagnostic tails) so runtime log chatter on fd 2 can never splice
+    into — or be mistaken for — the stdout JSON result line: extraction
+    uses stdout_lines only, diag_lines only feed error messages.
+    """
+    global _CHILD
+    env = dict(os.environ)
+    env.update(extra_env)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", COMPILE_CACHE_DIR)
+    env.setdefault("PYTHONUNBUFFERED", "1")
+    timeout_s = max(5.0, timeout_s)
+    # Mask the deadline signals across spawn→_CHILD assignment so a handler
+    # firing in that window can't miss the just-created group and orphan a
+    # TPU-holding child; pending signals deliver on unblock.
+    mask = {signal.SIGTERM, signal.SIGALRM}
+    signal.pthread_sigmask(signal.SIG_BLOCK, mask)
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-u", os.path.abspath(__file__)],
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            start_new_session=True,  # kill the whole group on timeout
+        )
+        _CHILD = proc
+    finally:
+        signal.pthread_sigmask(signal.SIG_UNBLOCK, mask)
+    out_lines: list[str] = []
+    err_lines: list[str] = []
+
+    echoed = [0]
+
+    def _pump(stream, into: list[str], echo_hash_only: bool) -> None:
+        for raw in stream:
+            line = raw.rstrip("\n")
+            into.append(line)
+            if line.startswith("#"):
+                _status(f"[child] {line.lstrip('# ')}")
+            elif not echo_hash_only and line.strip() and echoed[0] < 8:
+                echoed[0] += 1
+                sys.stderr.write(f"[child-err] {line[:240]}\n")
+                sys.stderr.flush()
+
+    readers = [
+        threading.Thread(
+            target=_pump, args=(proc.stdout, out_lines, True), daemon=True
+        ),
+        threading.Thread(
+            target=_pump, args=(proc.stderr, err_lines, False), daemon=True
+        ),
+    ]
+    for r in readers:
+        r.start()
+    try:
+        proc.wait(timeout=timeout_s)
+        timed_out = False
+    except subprocess.TimeoutExpired:
+        _killpg(proc)
+        proc.wait()
+        timed_out = True
+    for r in readers:
+        r.join(timeout=5)
+    _CHILD = None
+    diag = out_lines + [l for l in err_lines if l.strip()][-5:]
+    return (None if timed_out else proc.returncode), out_lines, diag
+
+
+def _extract_json_line(lines: list[str]) -> str | None:
+    """Last line that parses as the result record, if any."""
+    for line in reversed(lines):
         line = line.strip()
         if not line.startswith("{"):
             continue
@@ -102,10 +221,123 @@ def _extract_json_line(stdout: str) -> str | None:
     return None
 
 
+def main() -> None:
+    if os.environ.get("_GRAFT_BENCH_CHILD") == "1":
+        _bench()
+        return
+    if os.environ.get("_GRAFT_BENCH_PROBE") == "1":
+        _probe()
+        return
+
+    # Hard guarantees: the alarm fires at the self-deadline; SIGTERM from a
+    # driver-side `timeout` is converted into the error record before exit.
+    signal.signal(signal.SIGALRM, lambda *_: _emit_error(
+        f"self-deadline expired after {TOTAL_BUDGET_S}s (TPU backend slow or hung)"
+    ))
+    signal.signal(signal.SIGTERM, lambda *_: _emit_error(
+        "received SIGTERM (driver timeout) before a result was produced"
+    ))
+    signal.alarm(max(1, TOTAL_BUDGET_S))
+
+    cap = f"{ATTEMPT_TIMEOUT_S}s" if ATTEMPT_TIMEOUT_S > 0 else "full-clock"
+    _status(
+        f"bench start: budget={TOTAL_BUDGET_S}s probe<={PROBE_TIMEOUT_S}s "
+        f"attempts={ATTEMPTS}x{cap} cache={COMPILE_CACHE_DIR}"
+    )
+    try:
+        os.makedirs(COMPILE_CACHE_DIR, exist_ok=True)
+    except OSError:
+        pass
+
+    # Phase 1: bounded backend-init probe. A hung TPU claim loop dies here
+    # in ~PROBE_TIMEOUT_S instead of eating the whole budget.
+    t0 = time.monotonic()
+    rc, out, diag = _run_child(
+        {"_GRAFT_BENCH_PROBE": "1"}, min(PROBE_TIMEOUT_S, _remaining() - 10)
+    )
+    probe_dt = time.monotonic() - t0
+    tail = diag[-1][:300] if diag else "no output"
+    if rc is None:
+        _emit_error(
+            f"TPU backend init probe hung >{PROBE_TIMEOUT_S:.0f}s "
+            f"(pool unavailable); last: {tail}"
+        )
+    if rc != 0:
+        _emit_error(f"TPU backend init probe failed rc={rc}: {tail}")
+    plat = next((l for l in out if l.startswith("platform=")), tail)
+    _status(f"probe ok in {probe_dt:.1f}s: {plat}")
+
+    # Phase 2: the bench itself. Retries exist for fast flaky-init crashes;
+    # a *timed-out* attempt consumed the budget (e.g. cold-cache compile),
+    # so retrying colder-and-shorter is futile and only buries the
+    # informative tail — stop instead. Each attempt gets everything on the
+    # clock (minus a reserve to emit the record) rather than a fixed slice,
+    # so a cold compile that fits the total budget is never killed early.
+    err = "unknown"
+    for attempt in range(1, ATTEMPTS + 1):
+        budget = _remaining() - 10
+        if ATTEMPT_TIMEOUT_S > 0:
+            budget = min(ATTEMPT_TIMEOUT_S, budget)
+        if budget < 30:
+            err = f"budget exhausted before attempt {attempt} ({err})"
+            break
+        _status(f"attempt {attempt}/{ATTEMPTS} (timeout {budget:.0f}s)")
+        rc, out, diag = _run_child({"_GRAFT_BENCH_CHILD": "1"}, budget)
+        result = _extract_json_line(out)
+        if rc == 0 and result is not None:
+            _emit_result(result)
+        tail = next(
+            (l for l in reversed(diag) if l.strip() and not l.startswith("#")),
+            diag[-1] if diag else "no output",
+        )
+        err = (
+            f"attempt {attempt} "
+            + ("timed out" if rc is None else f"rc={rc}")
+            + f": {tail[:300]}"
+        )
+        _status(err)
+        if rc is None and budget >= _remaining() - 10:
+            break  # timeout ate the whole clock; a colder retry can't win
+            # (with an explicit per-attempt cap, clock may remain → retry)
+        # A retry must fit backend init (probe-measured) + compile + run.
+        if attempt < ATTEMPTS and _remaining() < probe_dt + 90:
+            break
+        if attempt < ATTEMPTS:
+            time.sleep(RETRY_BACKOFF_S)
+    _emit_error(f"TPU bench failed: {err}")
+
+
+def _force_platform() -> None:
+    """Honor GRAFT_BENCH_PLATFORM via the config API.
+
+    The image's sitecustomize re-latches ``JAX_PLATFORMS=axon`` during its
+    PJRT plugin registration, so the env var alone cannot select CPU; the
+    config API (applied after import, before backend init) can. Used for
+    envelope self-tests on machines without a live TPU.
+    """
+    plat = os.environ.get("GRAFT_BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
+def _probe() -> None:
+    """Child: init the backend and list devices, nothing else."""
+    _force_platform()
+    import jax
+
+    devs = jax.devices()
+    print(f"platform={devs[0].platform} n={len(devs)} {devs[0].device_kind}")
+
+
 def _bench() -> None:
+    _force_platform()
     import numpy as np
     import jax
     import jax.numpy as jnp
+
+    print("# child: backend up, building model", flush=True)
 
     from pytorch_distributedtraining_tpu import optim
     from pytorch_distributedtraining_tpu.losses import mse_loss
@@ -154,10 +386,12 @@ def _bench() -> None:
         jax.device_put(hr, jax.devices()[0]),
     )
 
+    print("# child: compiling + warmup", flush=True)
     with mesh:
         for _ in range(WARMUP):
             state, metrics = step(state, batch)
         jax.block_until_ready(metrics["loss"])
+        print("# child: warmup done, timing", flush=True)
         t0 = time.perf_counter()
         for _ in range(STEPS):
             state, metrics = step(state, batch)
@@ -178,4 +412,15 @@ def _bench() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — 'never silence' contract
+        # Parent-side bugs / fork failures must still yield the record.
+        # Child processes re-raise normally (the parent reads their rc).
+        if os.environ.get("_GRAFT_BENCH_CHILD") or os.environ.get(
+            "_GRAFT_BENCH_PROBE"
+        ):
+            raise
+        _emit_error(f"unexpected parent error: {type(e).__name__}: {e}")
