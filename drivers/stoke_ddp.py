@@ -91,6 +91,7 @@ def train(train_dataloader, stoke_model: Stoke, scheduler1, scheduler2, epoch: i
         # scheduler2 (plateau) steps on the validation metric in main();
         # the reference's per-batch `scheduler2.step` (:84) was dead code
 
+        # device scalar: accumulation stays async; float() only at logs
         sum_loss += stoke_model.detach_and_sync_loss(loss=train_loss)
 
         example_ct += len(inputs)
@@ -100,7 +101,7 @@ def train(train_dataloader, stoke_model: Stoke, scheduler1, scheduler2, epoch: i
             train_log(stoke_model.detach_and_sync_loss(train_loss), example_ct, epoch)
 
     avg_loss = sum_loss / max(1, len(train_dataloader))
-    return avg_loss
+    return float(avg_loss)  # one host sync per epoch, at the boundary
 
 
 def validate(val_dataloader, stoke_model: Stoke, epoch):
@@ -268,8 +269,14 @@ def main(argv=None):
     )
     # factor mode (no handle): the plateau cut feeds scheduler1.lr_scale so
     # OneCycle's per-batch writes don't clobber it — a bare torch pairing
-    # (reference :300-306) makes plateau cuts last one batch at most
-    scheduler2 = ReduceLROnPlateau(mode="min", factor=0.2, patience=2, verbose=True)
+    # (reference :300-306) makes plateau cuts last one batch at most.
+    # min_factor twins the reference's min_lr=5e-5 floor (:305) relative to
+    # the base lr: cumulative cuts never push lr below 5e-5 — and never
+    # above the base either (torch's min_lr floors, it never raises).
+    scheduler2 = ReduceLROnPlateau(
+        mode="min", factor=0.2, patience=2, verbose=True,
+        min_factor=min(1.0, 5e-5 / opt.lr),
+    )
 
     config = dict(
         epochs=opt.nEpochs,

@@ -15,6 +15,7 @@ from .collectives import (
     axis_size,
     barrier,
     sync_scalar,
+    sync_scalar_device,
     compressed_broadcast,
     host_all_gather,
     host_broadcast,
@@ -32,6 +33,7 @@ __all__ = [
     "axis_size",
     "barrier",
     "sync_scalar",
+    "sync_scalar_device",
     "compressed_broadcast",
     "host_all_gather",
     "host_broadcast",

