@@ -137,10 +137,14 @@ def main() -> None:
         grad_clip=ClipGradNormConfig(max_norm=0.1, norm_type=2.0),
     )
     stoke_model.init(lr_img)
+    # device-resident once, like path A: the ratio must isolate facade
+    # bookkeeping, not per-step H2D copies of the same host batch
+    lr_dev = jax.device_put(lr_img, jax.devices()[0])
+    hr_dev = jax.device_put(hr, jax.devices()[0])
 
     def facade_iter():
-        outputs = stoke_model.model(lr_img)
-        train_loss = stoke_model.loss(outputs, hr)
+        outputs = stoke_model.model(lr_dev)
+        train_loss = stoke_model.loss(outputs, hr_dev)
         stoke_model.print_ema_loss(prepend_msg="EMA Loss")
         stoke_model.backward(loss=train_loss)
         stoke_model.step()

@@ -21,18 +21,20 @@ trade off. One JSON line per arm. Results recorded in BASELINE.md.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from pytorch_distributedtraining_tpu import optim
 from pytorch_distributedtraining_tpu.losses import FeatLoss, VGGFeatLoss, mse_loss
 from pytorch_distributedtraining_tpu.metrics import mae, psnr
 from pytorch_distributedtraining_tpu.models import Net
-
-import os
 
 STEPS = int(os.environ.get("GRAFT_ABLATION_STEPS", "150"))
 BATCH = int(os.environ.get("GRAFT_ABLATION_BATCH", "8"))

@@ -24,6 +24,10 @@ import json
 import time
 
 import numpy as np
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _timed_steps(step, state, batch, n_steps, warmup):

@@ -21,6 +21,9 @@ import os
 import time
 
 import numpy as np
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 BENCH_RATE = float(os.environ.get("GRAFT_BENCH_RATE", "2935.0"))
 N_IMGS = int(os.environ.get("GRAFT_LOADER_IMGS", "256"))

@@ -15,6 +15,10 @@ import time
 import numpy as np
 import jax
 import jax.numpy as jnp
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from pytorch_distributedtraining_tpu import optim
 from pytorch_distributedtraining_tpu.losses import mse_loss

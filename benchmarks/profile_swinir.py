@@ -35,6 +35,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from pytorch_distributedtraining_tpu import optim
 from pytorch_distributedtraining_tpu.losses import mse_loss
@@ -358,6 +361,10 @@ def main():
             return nn.Dense(c, dtype=self.dtype, name="proj")(out)
 
     with_attention(BlockdiagAttn, "blockdiag_attn")
+
+    # fused Pallas window attention: probs never round-trip HBM
+    # (ops/pallas_window_attn.py; VERDICT r2 next-round item 2)
+    ablate({"attn_impl": "pallas"}, "pallas_window_attn")
 
     # bf16 softmax accumulation (no f32 round-trip on the [bn,h,n,n] probs)
     ablate({"softmax_dtype": jnp.bfloat16}, "bf16_softmax")

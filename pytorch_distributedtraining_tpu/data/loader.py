@@ -188,6 +188,8 @@ class DataLoader:
             return
         if self.sampler is None and not self.shuffle:
             return  # ordering is epoch-independent; no desync possible
+        if self.sampler is not None and not getattr(self.sampler, "shuffle", True):
+            return  # unshuffled sampler ignores the epoch entirely
         import jax
 
         if jax.process_count() <= 1:

@@ -114,18 +114,23 @@ class WindowAttention(nn.Module):
     window_size: int
     dtype: jnp.dtype = jnp.float32
     softmax_dtype: jnp.dtype = jnp.float32  # attention prob accumulation
+    # 'xla' einsum path, or 'pallas': the fused VMEM-resident kernel
+    # (ops/pallas_window_attn.py) that never writes the [bn, h, n, n]
+    # probabilities to HBM — same parameters, same math
+    attn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, mask=None):
+        if self.attn_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"attn_impl must be 'xla' or 'pallas', got {self.attn_impl!r}"
+            )
         bn, n, c = x.shape  # [B*nW, ws^2, C]
         h = self.num_heads
         head_dim = c // h
         qkv = nn.Dense(3 * c, use_bias=True, dtype=self.dtype, name="qkv")(x)
         qkv = qkv.reshape(bn, n, 3, h, head_dim).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]  # [bn, h, n, d]
-
-        scale = head_dim**-0.5
-        attn = (q * scale) @ k.transpose(0, 1, 3, 2)  # [bn, h, n, n]
 
         table = self.param(
             "relative_position_bias_table",
@@ -134,6 +139,22 @@ class WindowAttention(nn.Module):
         )
         idx = _relative_position_index(self.window_size)
         bias = table[idx.reshape(-1)].reshape(n, n, h).transpose(2, 0, 1)
+
+        if self.attn_impl == "pallas":
+            from ..ops import pallas_window_attn as pwa
+
+            out = pwa.window_attention(
+                q, k, v,
+                bias.astype(jnp.float32),
+                None if mask is None else jnp.asarray(mask),
+                16,
+                pwa.auto_interpret(),
+            )  # [bn, h, n, d], softmax in f32 in-kernel
+            out = out.transpose(0, 2, 1, 3).reshape(bn, n, c)
+            return nn.Dense(c, dtype=self.dtype, name="proj")(out)
+
+        scale = head_dim**-0.5
+        attn = (q * scale) @ k.transpose(0, 1, 3, 2)  # [bn, h, n, n]
         attn = attn + bias[None].astype(attn.dtype)
 
         if mask is not None:  # [nW, n, n] additive
@@ -161,6 +182,7 @@ class SwinLayer(nn.Module):
     dtype: jnp.dtype = jnp.float32
     norm_dtype: jnp.dtype = jnp.float32  # LN compute/storage dtype
     softmax_dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x):  # [B, H, W, C]
@@ -176,7 +198,8 @@ class SwinLayer(nn.Module):
         wins = window_partition(y.astype(self.dtype), ws)
         wins = WindowAttention(
             self.dim, self.num_heads, ws, dtype=self.dtype,
-            softmax_dtype=self.softmax_dtype, name="attn",
+            softmax_dtype=self.softmax_dtype, attn_impl=self.attn_impl,
+            name="attn",
         )(wins, mask)
         y = window_reverse(wins, ws, hgt, wid)
         if self.shift > 0:
@@ -202,6 +225,7 @@ class RSTB(nn.Module):
     dtype: jnp.dtype = jnp.float32
     norm_dtype: jnp.dtype = jnp.float32
     softmax_dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x):
@@ -212,6 +236,7 @@ class RSTB(nn.Module):
                 shift=0 if i % 2 == 0 else self.window_size // 2,
                 mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                 norm_dtype=self.norm_dtype, softmax_dtype=self.softmax_dtype,
+                attn_impl=self.attn_impl,
                 name=f"layer_{i}",
             )(x)
         # resi_connection='1conv' (Stoke-DDP.py:208)
@@ -240,6 +265,8 @@ class SwinIR(nn.Module):
     # see benchmarks/profile_swinir.py) at ~1e-2 output tolerance.
     norm_dtype: jnp.dtype = jnp.float32
     softmax_dtype: jnp.dtype = jnp.float32  # attention softmax accumulation
+    # 'xla' | 'pallas' — see WindowAttention.attn_impl
+    attn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x):  # [B, H, W, C] in [0, img_range]
@@ -271,7 +298,8 @@ class SwinIR(nn.Module):
             y = RSTB(
                 self.embed_dim, depth, heads, ws, self.mlp_ratio,
                 dtype=self.dtype, norm_dtype=self.norm_dtype,
-                softmax_dtype=self.softmax_dtype, name=f"rstb_{i}",
+                softmax_dtype=self.softmax_dtype, attn_impl=self.attn_impl,
+                name=f"rstb_{i}",
             )(y)
         y = nn.LayerNorm(dtype=self.norm_dtype, name="norm")(y).astype(self.dtype)
         y = nn.Conv(

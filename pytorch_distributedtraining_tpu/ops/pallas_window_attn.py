@@ -1,0 +1,235 @@
+"""Pallas TPU fused window attention for Swin-style models.
+
+SwinIR's hot op is (shifted-)window attention over tiny 64-token windows
+(`/root/reference/Stoke-DDP.py:206-208`: window_size=8, head_dim 10). The
+XLA path materializes the per-window attention probabilities
+``[B*nW, heads, 64, 64]`` through HBM every layer — at the flagship bench
+shape that is ~113 MB per STL in f32, by far the largest activation the
+model touches, and the roofline in BASELINE.md puts the step firmly in
+bandwidth-bound territory. This kernel keeps scores, bias, mask and
+softmax entirely in VMEM: one grid step loads a block of ``wb`` windows'
+q/k/v for one head, computes softmax(q·kᵀ·scale + bias + mask)·v in f32,
+and writes only the [wb, n, d] output back.
+
+The backward recomputes the probabilities in-kernel from q/k/v (the same
+no-O(n²)-residuals scheme as `pallas_attn.py`, trivially exact here since
+a 64x64 score tile needs no online softmax) and emits dq/dk/dv plus the
+relative-position-bias gradient, accumulated across the window grid in
+the revisited output block (grid iterates windows innermost per head).
+
+``window_attention`` is a drop-in for the einsum path in
+`models/swinir.py:WindowAttention` — same math, same parameters — and is
+exposed there as ``attn_impl='pallas'``. Off-TPU the kernels run in
+interpret mode so CPU tests exercise identical code.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, *rest, scale, has_mask):
+    if has_mask:
+        mask_ref, o_ref = rest
+    else:
+        (o_ref,) = rest
+    q = q_ref[:, 0].astype(jnp.float32) * scale  # [wb, n, d]
+    k = k_ref[:, 0].astype(jnp.float32)
+    v = v_ref[:, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # [wb, n, n]
+    s = s + bias_ref[0].astype(jnp.float32)[None]
+    if has_mask:
+        s = s + mask_ref[...].astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jax.lax.dot_general(
+        p, v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # [wb, n, d]
+    o_ref[:, 0] = o.astype(o_ref.dtype)
+
+
+def _bwd_kernel(
+    q_ref, k_ref, v_ref, bias_ref, *rest, scale, has_mask,
+):
+    if has_mask:
+        mask_ref, do_ref, dq_ref, dk_ref, dv_ref, dbias_ref = rest
+    else:
+        do_ref, dq_ref, dk_ref, dv_ref, dbias_ref = rest
+    i = pl.program_id(1)  # window-block index (innermost grid dim)
+    q = q_ref[:, 0].astype(jnp.float32) * scale
+    k = k_ref[:, 0].astype(jnp.float32)
+    v = v_ref[:, 0].astype(jnp.float32)
+    do = do_ref[:, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    s = s + bias_ref[0].astype(jnp.float32)[None]
+    if has_mask:
+        s = s + mask_ref[...].astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1)  # [wb, n, n]
+
+    # dv = pᵀ·do (contract query rows)
+    dv = jax.lax.dot_general(
+        p, do, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # [wb, n, d]
+    dp = jax.lax.dot_general(
+        do, v, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # [wb, n, n]
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = jax.lax.dot_general(
+        ds, k, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    dk = jax.lax.dot_general(
+        ds, q, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # [wb, n(k), d] — q already carries the scale
+    dq_ref[:, 0] = dq.astype(dq_ref.dtype)
+    dk_ref[:, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[:, 0] = dv.astype(dv_ref.dtype)
+
+    acc = jnp.sum(ds, axis=0)  # [n, n]: bias is shared across windows
+
+    @pl.when(i == 0)
+    def _init():
+        dbias_ref[0] = acc
+
+    @pl.when(i > 0)
+    def _accum():
+        dbias_ref[0] += acc
+
+
+def _specs(bn, h, n, d, wb, nw_mask):
+    """(q/k/v tile, bias tile, mask tile) BlockSpecs for grid (h, blocks)."""
+    qkv = pl.BlockSpec((wb, 1, n, d), lambda h_, i: (i, h_, 0, 0))
+    bias = pl.BlockSpec((1, n, n), lambda h_, i: (h_, 0, 0))
+    mask = None
+    if nw_mask is not None:
+        nblk = nw_mask // wb
+        mask = pl.BlockSpec((wb, n, n), lambda h_, i: (i % nblk, 0, 0))
+    return qkv, bias, mask
+
+
+def _validate(q, bias, mask, wb):
+    bn, h, n, d = q.shape
+    if bn % wb:
+        raise ValueError(f"window count {bn} must divide block size {wb}")
+    if bias.shape != (h, n, n):
+        raise ValueError(f"bias must be [heads, n, n], got {bias.shape}")
+    if mask is not None:
+        nw = mask.shape[0]
+        if nw % wb and wb % nw:
+            raise ValueError(
+                f"mask window count {nw} and block {wb} must nest"
+            )
+
+
+def _effective_wb(bn, mask, wb):
+    # block size must divide both the total window count and (when a shift
+    # mask is present) the per-image window count so mask indexing tiles
+    wb = min(wb, bn)
+    while bn % wb or (mask is not None and mask.shape[0] % wb):
+        wb -= 1
+    return wb
+
+
+def _forward(q, k, v, bias, mask, *, wb, interpret):
+    bn, h, n, d = q.shape
+    wb = _effective_wb(bn, mask, wb)
+    _validate(q, bias, mask, wb)
+    scale = d**-0.5
+    qkv_spec, bias_spec, mask_spec = _specs(
+        bn, h, n, d, wb, None if mask is None else mask.shape[0]
+    )
+    in_specs = [qkv_spec, qkv_spec, qkv_spec, bias_spec]
+    args = [q, k, v, bias]
+    if mask is not None:
+        in_specs.append(mask_spec)
+        args.append(mask)
+    out = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, has_mask=mask is not None
+        ),
+        grid=(h, bn // wb),
+        in_specs=in_specs,
+        out_specs=qkv_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(*args)
+    return out
+
+
+def _backward_impl(q, k, v, bias, mask, do, *, wb, interpret):
+    bn, h, n, d = q.shape
+    wb = _effective_wb(bn, mask, wb)
+    scale = d**-0.5
+    qkv_spec, bias_spec, mask_spec = _specs(
+        bn, h, n, d, wb, None if mask is None else mask.shape[0]
+    )
+    in_specs = [qkv_spec, qkv_spec, qkv_spec, bias_spec]
+    args = [q, k, v, bias]
+    if mask is not None:
+        in_specs.append(mask_spec)
+        args.append(mask)
+    in_specs.append(qkv_spec)  # do
+    args.append(do)
+    dq, dk, dv, dbias = pl.pallas_call(
+        functools.partial(
+            _bwd_kernel, scale=scale, has_mask=mask is not None
+        ),
+        grid=(h, bn // wb),
+        in_specs=in_specs,
+        out_specs=[qkv_spec, qkv_spec, qkv_spec, bias_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct((h, n, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return dq, dk, dv, dbias
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def window_attention(q, k, v, bias, mask, wb: int = 16,
+                     interpret: bool = False):
+    """Fused softmax(q·kᵀ/√d + bias [+ mask])·v over independent windows.
+
+    q/k/v: ``[B*nW, heads, n, d]``; bias: ``[heads, n, n]`` (the gathered
+    relative-position bias); mask: ``[nW, n, n]`` additive shift mask or
+    None. Returns ``[B*nW, heads, n, d]``. Gradients flow to q/k/v/bias.
+    """
+    return _forward(q, k, v, bias, mask, wb=wb, interpret=interpret)
+
+
+def _vjp_fwd(q, k, v, bias, mask, wb, interpret):
+    out = _forward(q, k, v, bias, mask, wb=wb, interpret=interpret)
+    return out, (q, k, v, bias, mask)
+
+
+def _vjp_bwd(wb, interpret, res, g):
+    q, k, v, bias, mask = res
+    dq, dk, dv, dbias = _backward_impl(
+        q, k, v, bias, mask, g, wb=wb, interpret=interpret
+    )
+    dmask = None if mask is None else jnp.zeros_like(mask)
+    return dq, dk, dv, dbias.astype(bias.dtype), dmask
+
+
+window_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def auto_interpret() -> bool:
+    """Interpret kernels off-TPU so CPU tests run the same code."""
+    return jax.devices()[0].platform != "tpu"
