@@ -351,7 +351,23 @@ def _bench() -> None:
     from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
 
     mesh = make_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
-    model = SwinIR(dtype=jnp.bfloat16)  # reference config, bf16 MXU path
+    # Ablation-winner knobs (benchmarks/profile_swinir.py decides; flip the
+    # default once a variant proves out on chip): attention implementation
+    # and norm/softmax dtypes.
+    model = SwinIR(
+        dtype=jnp.bfloat16,  # reference config, bf16 MXU path
+        attn_impl=os.environ.get("GRAFT_BENCH_ATTN", "xla"),
+        norm_dtype=(
+            jnp.bfloat16
+            if os.environ.get("GRAFT_BENCH_NORM") == "bf16"
+            else jnp.float32
+        ),
+        softmax_dtype=(
+            jnp.bfloat16
+            if os.environ.get("GRAFT_BENCH_SOFTMAX") == "bf16"
+            else jnp.float32
+        ),
+    )
     tx = optim.adamw(lr=5e-4, clip_grad_norm=0.1)  # Stoke-DDP.py:253,164
     policy = DDP()
 

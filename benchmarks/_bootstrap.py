@@ -1,0 +1,14 @@
+"""Make the repo root importable when a benchmark runs by path.
+
+``python benchmarks/<script>.py`` puts ``benchmarks/`` (this directory) on
+``sys.path[0]`` but not the repo root, so ``import _bootstrap`` from any
+benchmark both resolves this module and, on import, prepends the root —
+one place to change if the package location ever moves.
+"""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

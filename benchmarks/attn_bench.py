@@ -17,7 +17,7 @@ import time
 import numpy as np
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 
 def main():

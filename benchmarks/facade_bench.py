@@ -19,10 +19,9 @@ from __future__ import annotations
 
 import json
 import os
-import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 CPU_SELF_TEST = os.environ.get("GRAFT_BENCH_PLATFORM") == "cpu"
 STEPS = max(1, int(

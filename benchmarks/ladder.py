@@ -27,7 +27,7 @@ import numpy as np
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 
 def _timed_steps(step, state, batch, n_steps, warmup):

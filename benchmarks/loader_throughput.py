@@ -23,7 +23,7 @@ import time
 import numpy as np
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 BENCH_RATE = float(os.environ.get("GRAFT_BENCH_RATE", "2935.0"))
 N_IMGS = int(os.environ.get("GRAFT_LOADER_IMGS", "256"))

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import flax.linen as nn
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 from pytorch_distributedtraining_tpu import optim
 from pytorch_distributedtraining_tpu.losses import mse_loss

@@ -135,11 +135,21 @@ def load_params_dict(
     flat_tpl = tree_to_flat_dict(template)
     missing = sorted(set(flat_tpl) - set(flat_src))
     unexpected = sorted(set(flat_src) - set(flat_tpl))
-    if strict and (missing or unexpected):
-        raise ValueError(
-            f"strict load failed — missing: {missing[:5]}"
-            f"{'...' if len(missing) > 5 else ''}, unexpected: {unexpected[:5]}"
-            f"{'...' if len(unexpected) > 5 else ''}"
+    if missing or unexpected:
+        detail = (
+            f"missing: {missing[:5]}{'...' if len(missing) > 5 else ''}, "
+            f"unexpected: {unexpected[:5]}{'...' if len(unexpected) > 5 else ''}"
+        )
+        if strict:
+            raise ValueError(f"strict load failed — {detail}")
+        # torch returns IncompatibleKeys; surfacing the same information
+        # as a warning keeps the non-strict path honest instead of silent
+        import warnings
+
+        warnings.warn(
+            f"non-strict load skipped keys — {detail}",
+            RuntimeWarning,
+            stacklevel=2,
         )
     out = dict(flat_tpl)
     for k in flat_tpl:

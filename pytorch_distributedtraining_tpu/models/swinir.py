@@ -141,6 +141,14 @@ class WindowAttention(nn.Module):
         bias = table[idx.reshape(-1)].reshape(n, n, h).transpose(2, 0, 1)
 
         if self.attn_impl == "pallas":
+            if self.softmax_dtype != jnp.float32:
+                # the kernel always accumulates softmax in f32; refusing a
+                # bf16 request keeps ablation arms honestly labeled
+                raise ValueError(
+                    "attn_impl='pallas' computes softmax in f32 in-kernel; "
+                    f"softmax_dtype={self.softmax_dtype} is not honored — "
+                    "use the 'xla' impl for bf16-softmax experiments"
+                )
             from ..ops import pallas_window_attn as pwa
 
             out = pwa.window_attention(

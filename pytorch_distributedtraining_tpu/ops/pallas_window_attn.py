@@ -120,18 +120,13 @@ def _specs(bn, h, n, d, wb, nw_mask):
     return qkv, bias, mask
 
 
-def _validate(q, bias, mask, wb):
+def _validate(q, bias, mask):
+    """Shape contract; block-size divisibility is handled by _effective_wb."""
     bn, h, n, d = q.shape
-    if bn % wb:
-        raise ValueError(f"window count {bn} must divide block size {wb}")
     if bias.shape != (h, n, n):
         raise ValueError(f"bias must be [heads, n, n], got {bias.shape}")
-    if mask is not None:
-        nw = mask.shape[0]
-        if nw % wb and wb % nw:
-            raise ValueError(
-                f"mask window count {nw} and block {wb} must nest"
-            )
+    if mask is not None and mask.shape[-2:] != (n, n):
+        raise ValueError(f"mask must be [nW, {n}, {n}], got {mask.shape}")
 
 
 def _effective_wb(bn, mask, wb):
@@ -145,8 +140,8 @@ def _effective_wb(bn, mask, wb):
 
 def _forward(q, k, v, bias, mask, *, wb, interpret):
     bn, h, n, d = q.shape
+    _validate(q, bias, mask)
     wb = _effective_wb(bn, mask, wb)
-    _validate(q, bias, mask, wb)
     scale = d**-0.5
     qkv_spec, bias_spec, mask_spec = _specs(
         bn, h, n, d, wb, None if mask is None else mask.shape[0]
@@ -171,6 +166,7 @@ def _forward(q, k, v, bias, mask, *, wb, interpret):
 
 def _backward_impl(q, k, v, bias, mask, do, *, wb, interpret):
     bn, h, n, d = q.shape
+    _validate(q, bias, mask)
     wb = _effective_wb(bn, mask, wb)
     scale = d**-0.5
     qkv_spec, bias_spec, mask_spec = _specs(

@@ -190,6 +190,31 @@ class TestTorchInterop:
                 load_torch_checkpoint(path), template, strict=True
             )
 
+    def test_non_strict_load_warns_and_keeps_template(self, tmp_path):
+        """torch returns IncompatibleKeys from a non-strict load; the twin
+        surfaces the same information as a RuntimeWarning instead of
+        silently skipping (MIGRATION.md checkpoint row)."""
+        pytest.importorskip("torch")
+        from pytorch_distributedtraining_tpu.interop import (
+            load_torch_checkpoint,
+            save_torch_checkpoint,
+        )
+
+        model = Net(upscale_factor=2)
+        template = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3))
+        )["params"]
+        src = dict(jax.tree.map(np.asarray, template))
+        src["rogue"] = np.zeros(3, np.float32)
+        path = str(tmp_path / "mixed.pth")
+        save_torch_checkpoint(path, {"params": src})
+        with pytest.warns(RuntimeWarning, match="rogue"):
+            params = load_params_dict(
+                load_torch_checkpoint(path), template, strict=False
+            )
+        # matched keys loaded, template structure intact
+        assert set(params) == set(template)
+
     def test_torch_layout_conversion(self):
         from pytorch_distributedtraining_tpu.interop import (
             convert_torch_tensors,
