@@ -184,6 +184,17 @@ def analytic_model():
     }
 
 
+def _rel_bias(module, n, h):
+    """Shared relative-position-bias gather (mirrors WindowAttention)."""
+    table = module.param(
+        "relative_position_bias_table",
+        nn.initializers.truncated_normal(0.02),
+        ((2 * module.window_size - 1) ** 2, h),
+    )
+    idx = swinir_mod._relative_position_index(module.window_size)
+    return table[idx.reshape(-1)].reshape(n, n, h).transpose(2, 0, 1)
+
+
 def main():
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         jax.config.update("jax_platforms", "cpu")  # sitecustomize latch
@@ -335,13 +346,7 @@ def main():
             s = (q2 * scale) @ kblk  # [bn, n, h*n]
             attn = s.reshape(bn, n, h, n).transpose(0, 2, 1, 3)
 
-            table = self.param(
-                "relative_position_bias_table",
-                nn.initializers.truncated_normal(0.02),
-                ((2 * self.window_size - 1) ** 2, h),
-            )
-            idx = swinir_mod._relative_position_index(self.window_size)
-            bias = table[idx.reshape(-1)].reshape(n, n, h).transpose(2, 0, 1)
+            bias = _rel_bias(self, n, h)
             attn = attn + bias[None].astype(attn.dtype)
             if mask is not None:
                 nw = mask.shape[0]
@@ -361,6 +366,67 @@ def main():
             return nn.Dense(c, dtype=self.dtype, name="proj")(out)
 
     with_attention(BlockdiagAttn, "blockdiag_attn")
+
+    class PairedWindowAttn(swinir_mod.WindowAttention):
+        """Two windows packed into one M=128 attention: scores become
+        [2n, 2n] with an additive block-diagonal mask (off-diagonal
+        -100 -> softmax ~0, same trick as the shift mask), so each
+        score/AV matmul fills a full 128-row MXU tile instead of two
+        half-empty 64-row passes — 2x fewer MXU passes for 2x larger
+        intermediates. Data decides."""
+
+        @nn.compact
+        def __call__(self, x, mask=None):
+            bn, n, c = x.shape
+            h = self.num_heads
+            head_dim = c // h
+            p = 2  # windows per pack: p*n = 128 exactly at ws=8
+            if bn % p:
+                raise ValueError(f"window count {bn} not divisible by {p}")
+            if mask is not None and mask.shape[0] % p:
+                # shifted layers need whole pairs within one image's nW
+                raise ValueError(
+                    f"per-image window count {mask.shape[0]} not "
+                    f"divisible by pack size {p}"
+                )
+            # unshifted layers may pair across image boundaries: the kill
+            # mask zeroes all cross-window probs, so pairing is image-blind
+            qkv = nn.Dense(3 * c, use_bias=True, dtype=self.dtype, name="qkv")(x)
+            qkv = qkv.reshape(bn // p, p * n, 3, h, head_dim).transpose(
+                2, 0, 3, 1, 4
+            )
+            q, k, v = qkv[0], qkv[1], qkv[2]  # [bn/p, h, p*n, d]
+            scale = head_dim**-0.5
+            attn = (q * scale) @ k.transpose(0, 1, 3, 2)  # [bn/p, h, pn, pn]
+
+            bias = _rel_bias(self, n, h)
+            # block-diag tile of the per-window bias + cross-window kill
+            eye = jnp.eye(p, dtype=bias.dtype)
+            bias_pair = jnp.einsum("ab,hnm->hanbm", eye, bias).reshape(
+                h, p * n, p * n
+            )
+            kill = (1.0 - jnp.eye(p)) * -100.0
+            kill = jnp.repeat(jnp.repeat(kill, n, 0), n, 1)  # [pn, pn]
+            attn = attn + (bias_pair + kill[None]).astype(attn.dtype)[None]
+
+            if mask is not None:  # [nW, n, n] per-window shift mask
+                nw = mask.shape[0]
+                m = jnp.asarray(mask).reshape(nw // p, p, n, n)
+                m_pair = jnp.einsum(
+                    "ab,wanm->wanbm", eye.astype(m.dtype), m
+                ).reshape(nw // p, p * n, p * n)
+                attn = attn.reshape(
+                    bn // nw, nw // p, h, p * n, p * n
+                ) + m_pair[None, :, None].astype(attn.dtype)
+                attn = attn.reshape(bn // p, h, p * n, p * n)
+
+            attn = jax.nn.softmax(
+                attn.astype(self.softmax_dtype), axis=-1
+            ).astype(self.dtype)
+            out = (attn @ v).transpose(0, 2, 1, 3).reshape(bn, n, c)
+            return nn.Dense(c, dtype=self.dtype, name="proj")(out)
+
+    with_attention(PairedWindowAttn, "paired_windows")
 
     # fused Pallas window attention: probs never round-trip HBM
     # (ops/pallas_window_attn.py; VERDICT r2 next-round item 2)
