@@ -49,7 +49,12 @@ def main():
         jax.block_until_ready(out)
         return (time.perf_counter() - t0) / STEPS
 
-    for T in (512, 1024, 2048, 4096):
+    sizes = tuple(
+        int(t) for t in os.environ.get(
+            "GRAFT_ATTN_SIZES", "512,1024,2048,4096"
+        ).split(",")
+    )
+    for T in sizes:
         rng = np.random.default_rng(0)
         q, k, v = (
             jnp.asarray(
@@ -77,6 +82,35 @@ def main():
                 jax.grad(flash_loss, argnums=(0, 1, 2))
             ),
         }
+
+        # correctness on this hardware first (VERDICT r2 item 3): fwd and
+        # grad outputs of the Pallas kernels vs XLA attention in bf16,
+        # reusing the timing arms' compiled programs. Gate hard: timing a
+        # wrong-math kernel must fail the bench, not decorate it.
+        o_xla = jax.jit(
+            lambda q, k, v: default_attention(q, k, v, causal=True)
+        )(q, k, v).astype(jnp.float32)
+        o_fl = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, True, 128, 128, interpret)
+        )(q, k, v).astype(jnp.float32)
+        g_xla = arms[("xla", "fwd+bwd")](q, k, v)
+        g_fl = arms[("flash", "fwd+bwd")](q, k, v)
+        gerr = max(
+            float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+            for a, b in zip(g_xla, g_fl)
+        )
+        ferr = float(jnp.max(jnp.abs(o_xla - o_fl)))
+        print(json.dumps({
+            "T": T, "impl": "flash", "pass": "correctness",
+            "max_abs_err_fwd": round(ferr, 6),
+            "max_abs_err_grad": round(gerr, 6),
+        }), flush=True)
+        # bf16 rounding at these magnitudes is ~1e-2; a real kernel bug is
+        # orders of magnitude above these bounds
+        if ferr > 0.1 or gerr > 0.3:
+            raise SystemExit(
+                f"flash-vs-XLA mismatch at T={T}: fwd {ferr}, grad {gerr}"
+            )
         for (impl, passes), fn in arms.items():
             sec = time_fn(fn, q, k, v)
             # attention flops: 2 matmuls * 2 flops * B*H*T^2*D (causal ~1/2)
