@@ -35,7 +35,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
-import sys
 
 import _bootstrap  # noqa: F401  (repo root on sys.path)
 

@@ -116,3 +116,23 @@ def test_swinir_attn_impl_parity_with_shift():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=5e-4
         )
+
+
+def test_kernel_flagship_shape_parity():
+    """Exact bench-config attention shape (n=64 tokens, 6 heads, d=10,
+    wb=16) — the shape the chip will run; interpret mode, fwd + grads."""
+    q, k, v = _qkv(bn=16, h=6, n=64, d=10, seed=4)
+    r = np.random.default_rng(5)
+    bias = jnp.asarray(r.standard_normal((6, 64, 64)), jnp.float32)
+
+    def loss_p(q, k, v, bias):
+        return jnp.sum(pwa.window_attention(q, k, v, bias, None, 16, True) ** 2)
+
+    def loss_r(q, k, v, bias):
+        return jnp.sum(_ref(q, k, v, bias, None) ** 2)
+
+    lp, gp = jax.value_and_grad(loss_p, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    lr_, gr = jax.value_and_grad(loss_r, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    np.testing.assert_allclose(float(lp), float(lr_), rtol=1e-5)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
