@@ -48,11 +48,13 @@ def main():
         jax.block_until_ready(out)
         return (time.perf_counter() - t0) / STEPS
 
-    sizes = tuple(
-        int(t) for t in os.environ.get(
-            "GRAFT_ATTN_SIZES", "512,1024,2048,4096"
-        ).split(",") if t.strip()
-    )
+    raw = os.environ.get("GRAFT_ATTN_SIZES", "512,1024,2048,4096")
+    try:
+        sizes = tuple(int(t) for t in raw.split(",") if t.strip())
+    except ValueError:
+        raise SystemExit(
+            f"GRAFT_ATTN_SIZES must be comma-separated ints, got {raw!r}"
+        )
     if not sizes:
         raise SystemExit("GRAFT_ATTN_SIZES parsed to no sizes")
     for T in sizes:

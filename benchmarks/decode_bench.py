@@ -1,0 +1,91 @@
+"""GPT-2 decode throughput: tokens/sec through the compiled KV-cache loop.
+
+The generation path (`models/generate.py`: chunked prefill + `lax.scan`
+decode with per-layer KV caches, top-k/top-p in-loop) is part of the
+framework surface beyond the reference contract; this stages its on-chip
+number next to the training ladder. Measures GPT-2 125M (the BASELINE
+ladder's transformer), batch 8, 128-token prompt, 128 new tokens, bf16.
+
+One JSON line per arm:
+    {"metric": "gpt2_decode_tokens_per_sec", ...}   (greedy)
+    {"metric": "gpt2_decode_topp_tokens_per_sec", ...}  (top-p 0.9)
+
+Env: GRAFT_BENCH_PLATFORM=cpu -> tiny model CPU self-test;
+GRAFT_DECODE_BATCH / GRAFT_DECODE_PROMPT / GRAFT_DECODE_NEW resize.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+CPU_SELF_TEST = os.environ.get("GRAFT_BENCH_PLATFORM") == "cpu"
+BATCH = max(1, int(os.environ.get("GRAFT_DECODE_BATCH", "2" if CPU_SELF_TEST else "8")))
+PROMPT = max(2, int(os.environ.get("GRAFT_DECODE_PROMPT", "16" if CPU_SELF_TEST else "128")))
+NEW = max(2, int(os.environ.get("GRAFT_DECODE_NEW", "16" if CPU_SELF_TEST else "128")))
+REPS = max(1, int(os.environ.get("GRAFT_DECODE_REPS", "1" if CPU_SELF_TEST else "5")))
+
+
+def main() -> None:
+    if CPU_SELF_TEST:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update(
+        "jax_compilation_cache_dir", f"/tmp/jax_bench_cache_{os.getuid()}"
+    )
+
+    from pytorch_distributedtraining_tpu.models.gpt2 import GPT2, GPT2Config
+    from pytorch_distributedtraining_tpu.models.generate import generate
+
+    if CPU_SELF_TEST:
+        cfg = GPT2Config(
+            vocab_size=256, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+            dtype=jnp.bfloat16,
+        )
+    else:  # GPT-2 125M (BASELINE ladder config 4's model), bf16 compute
+        cfg = GPT2Config(dtype=jnp.bfloat16)
+    model = GPT2(cfg, decode=True)
+    train_model = GPT2(cfg, decode=False)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (BATCH, PROMPT)), jnp.int32
+    )
+    params = train_model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, PROMPT), jnp.int32)
+    )["params"]
+
+    for metric, kwargs in (
+        ("gpt2_decode_tokens_per_sec", dict(temperature=0.0)),
+        ("gpt2_decode_topp_tokens_per_sec", dict(top_p=0.9)),
+    ):
+        run = jax.jit(
+            lambda p, pr: generate(
+                model, p, pr, NEW, rng=jax.random.PRNGKey(1), **kwargs
+            )
+        )
+        out = run(params, prompt)  # compile + warm
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            out = run(params, prompt)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / REPS
+        assert out.shape == (BATCH, PROMPT + NEW), out.shape
+        print(json.dumps({
+            "metric": metric,
+            "value": round(BATCH * NEW / dt, 1),
+            "unit": "tokens/sec",
+            "ms_per_token": round(dt / NEW * 1e3, 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

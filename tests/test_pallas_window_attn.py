@@ -120,8 +120,10 @@ def test_swinir_attn_impl_parity_with_shift():
 
 def test_kernel_flagship_shape_parity():
     """Exact bench-config attention shape (n=64 tokens, 6 heads, d=10,
-    wb=16) — the shape the chip will run; interpret mode, fwd + grads."""
-    q, k, v = _qkv(bn=16, h=6, n=64, d=10, seed=4)
+    wb=16) — the shape the chip will run; interpret mode, fwd + grads.
+    bn=32 windows = two grid blocks, so the backward's cross-block dbias
+    accumulation is exercised at this geometry too."""
+    q, k, v = _qkv(bn=32, h=6, n=64, d=10, seed=4)
     r = np.random.default_rng(5)
     bias = jnp.asarray(r.standard_normal((6, 64, 64)), jnp.float32)
 
