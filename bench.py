@@ -354,9 +354,17 @@ def _bench() -> None:
     # Ablation-winner knobs (benchmarks/profile_swinir.py decides; flip the
     # default once a variant proves out on chip): attention implementation
     # and norm/softmax dtypes.
+    try:
+        attn_pack = int(os.environ.get("GRAFT_BENCH_ATTN_PACK", "1"))
+    except ValueError:
+        raise SystemExit(
+            "GRAFT_BENCH_ATTN_PACK must be an int, got "
+            f"{os.environ['GRAFT_BENCH_ATTN_PACK']!r}"
+        )
     model = SwinIR(
         dtype=jnp.bfloat16,  # reference config, bf16 MXU path
         attn_impl=os.environ.get("GRAFT_BENCH_ATTN", "xla"),
+        attn_pack=attn_pack,
         norm_dtype=(
             jnp.bfloat16
             if os.environ.get("GRAFT_BENCH_NORM") == "bf16"

@@ -430,6 +430,8 @@ def main():
     # fused Pallas window attention: probs never round-trip HBM
     # (ops/pallas_window_attn.py; VERDICT r2 next-round item 2)
     ablate({"attn_impl": "pallas"}, "pallas_window_attn")
+    # + window pairing inside the kernel path: full 128-row MXU tiles
+    ablate({"attn_impl": "pallas", "attn_pack": 2}, "pallas_packed")
 
     # bf16 softmax accumulation (no f32 round-trip on the [bn,h,n,n] probs)
     ablate({"softmax_dtype": jnp.bfloat16}, "bf16_softmax")

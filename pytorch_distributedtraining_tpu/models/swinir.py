@@ -118,6 +118,9 @@ class WindowAttention(nn.Module):
     # (ops/pallas_window_attn.py) that never writes the [bn, h, n, n]
     # probabilities to HBM — same parameters, same math
     attn_impl: str = "xla"
+    # pallas impl only: fuse this many windows per attention tile (2 packs
+    # SwinIR's 64-token windows into full 128-row MXU tiles)
+    attn_pack: int = 1
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -151,11 +154,18 @@ class WindowAttention(nn.Module):
                 )
             from ..ops import pallas_window_attn as pwa
 
-            out = pwa.window_attention(
+            # pack only when the window counts divide (odd per-image window
+            # counts are legal SwinIR inputs — fall back to pack=1 there
+            # rather than failing mid-forward)
+            pk = max(1, self.attn_pack)
+            if bn % pk or (mask is not None and mask.shape[0] % pk):
+                pk = 1
+            out = pwa.window_attention_packed(
                 q, k, v,
                 bias.astype(jnp.float32),
                 None if mask is None else jnp.asarray(mask),
-                16,
+                pk,
+                max(1, 16 // pk),
                 pwa.auto_interpret(),
             )  # [bn, h, n, d], softmax in f32 in-kernel
             out = out.transpose(0, 2, 1, 3).reshape(bn, n, c)
@@ -191,6 +201,7 @@ class SwinLayer(nn.Module):
     norm_dtype: jnp.dtype = jnp.float32  # LN compute/storage dtype
     softmax_dtype: jnp.dtype = jnp.float32
     attn_impl: str = "xla"
+    attn_pack: int = 1
 
     @nn.compact
     def __call__(self, x):  # [B, H, W, C]
@@ -207,6 +218,7 @@ class SwinLayer(nn.Module):
         wins = WindowAttention(
             self.dim, self.num_heads, ws, dtype=self.dtype,
             softmax_dtype=self.softmax_dtype, attn_impl=self.attn_impl,
+            attn_pack=self.attn_pack,
             name="attn",
         )(wins, mask)
         y = window_reverse(wins, ws, hgt, wid)
@@ -234,6 +246,7 @@ class RSTB(nn.Module):
     norm_dtype: jnp.dtype = jnp.float32
     softmax_dtype: jnp.dtype = jnp.float32
     attn_impl: str = "xla"
+    attn_pack: int = 1
 
     @nn.compact
     def __call__(self, x):
@@ -244,7 +257,7 @@ class RSTB(nn.Module):
                 shift=0 if i % 2 == 0 else self.window_size // 2,
                 mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                 norm_dtype=self.norm_dtype, softmax_dtype=self.softmax_dtype,
-                attn_impl=self.attn_impl,
+                attn_impl=self.attn_impl, attn_pack=self.attn_pack,
                 name=f"layer_{i}",
             )(x)
         # resi_connection='1conv' (Stoke-DDP.py:208)
@@ -275,6 +288,7 @@ class SwinIR(nn.Module):
     softmax_dtype: jnp.dtype = jnp.float32  # attention softmax accumulation
     # 'xla' | 'pallas' — see WindowAttention.attn_impl
     attn_impl: str = "xla"
+    attn_pack: int = 1  # pallas impl: windows fused per attention tile
 
     @nn.compact
     def __call__(self, x):  # [B, H, W, C] in [0, img_range]
@@ -307,6 +321,7 @@ class SwinIR(nn.Module):
                 self.embed_dim, depth, heads, ws, self.mlp_ratio,
                 dtype=self.dtype, norm_dtype=self.norm_dtype,
                 softmax_dtype=self.softmax_dtype, attn_impl=self.attn_impl,
+                attn_pack=self.attn_pack,
                 name=f"rstb_{i}",
             )(y)
         y = nn.LayerNorm(dtype=self.norm_dtype, name="norm")(y).astype(self.dtype)
