@@ -411,10 +411,19 @@ def _bench() -> None:
     )
 
     print("# child: compiling + warmup", flush=True)
+    trace_dir = os.environ.get("GRAFT_BENCH_TRACE")
     with mesh:
         for _ in range(WARMUP):
             state, metrics = step(state, batch)
         jax.block_until_ready(metrics["loss"])
+        if trace_dir:
+            # op-level profile of a few steady-state steps (xplane into
+            # trace_dir) for MFU analysis; timed loop runs untraced after
+            print(f"# child: tracing 3 steps -> {trace_dir}", flush=True)
+            with jax.profiler.trace(trace_dir):
+                for _ in range(3):
+                    state, metrics = step(state, batch)
+                jax.block_until_ready(metrics["loss"])
         print("# child: warmup done, timing", flush=True)
         t0 = time.perf_counter()
         for _ in range(STEPS):

@@ -116,6 +116,64 @@ def spawn(
     return None
 
 
+def _run_world(opt, attempt: int) -> int:
+    """Launch one generation of the world; 0 on success.
+
+    A crashed rank strands the others in the rendezvous/collective, so the
+    monitor polls all children, kills the survivors on the first non-zero
+    exit, and reports — the fate-sharing ``torch.distributed.launch``
+    provides.
+    """
+    world = opt.nnodes * opt.nproc_per_node
+    # fresh port per generation: the previous coordinator socket may
+    # linger in TIME_WAIT after a crash
+    port = opt.master_port or find_free_port()
+    procs = []
+    for local_rank in range(opt.nproc_per_node):
+        rank = opt.node_rank * opt.nproc_per_node + local_rank
+        env = _child_env(
+            rank, local_rank, world, opt.master_addr, port,
+            opt.one_cpu_device_per_rank,
+        )
+        # scripts can adapt (e.g. resume from the preemption checkpoint,
+        # cf. --start-epoch "useful on restarts", Stoke-DDP.py:161)
+        env["GRAFT_RESTART_ATTEMPT"] = str(attempt)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, opt.script, *opt.script_args], env=env
+            )
+        )
+    import time as _time
+
+    code = 0
+    failed_at = None
+    try:
+        while procs:
+            for p in list(procs):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                procs.remove(p)
+                if rc != 0:
+                    code = code or rc
+                    failed_at = failed_at or _time.monotonic()
+                    for q in procs:
+                        q.terminate()
+            # escalate: a survivor trapping SIGTERM (e.g. writing its
+            # preemption checkpoint while stuck in the dead collective)
+            # must not stall the monitor forever
+            if failed_at is not None and _time.monotonic() - failed_at > 15.0:
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+            _time.sleep(0.1)
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+    return code
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="TPU-native torch.distributed.launch twin"
@@ -129,46 +187,41 @@ def main(argv=None) -> int:
         "--one_cpu_device_per_rank", action="store_true",
         help="give each rank a single virtual CPU device (localhost testing)",
     )
+    parser.add_argument(
+        "--max_restarts", type=int, default=0,
+        help="elastic twin of torchrun --max-restarts: on any rank failure "
+        "the whole world is killed and relaunched (fresh rendezvous) up to "
+        "N times; children see GRAFT_RESTART_ATTEMPT and should resume "
+        "from their last checkpoint (cf. --start-epoch, Stoke-DDP.py:161)",
+    )
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     opt = parser.parse_args(argv)
 
-    world = opt.nnodes * opt.nproc_per_node
-    port = opt.master_port or find_free_port()
-    procs = []
-    for local_rank in range(opt.nproc_per_node):
-        rank = opt.node_rank * opt.nproc_per_node + local_rank
-        env = _child_env(
-            rank, local_rank, world, opt.master_addr, port,
-            opt.one_cpu_device_per_rank,
+    if opt.max_restarts < 0:
+        parser.error("--max_restarts must be >= 0 (torchrun rejects -1 too)")
+    if opt.max_restarts > 0 and opt.nnodes > 1:
+        # each node's launcher only sees its local ranks; restarting one
+        # node's generation while the others poll the dead collective can
+        # never reform the world — multi-node elastic needs an external
+        # agent coordinating all nodes (out of scope, as with
+        # torch.distributed.launch itself)
+        parser.error(
+            "--max_restarts requires single-node (--nnodes=1); multi-node "
+            "elastic recovery needs an external coordinator"
         )
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, opt.script, *opt.script_args], env=env
-            )
-        )
-    # poll, don't wait sequentially: a crashed rank strands the others in
-    # the rendezvous/collective, so kill the survivors and report (the same
-    # fate-sharing torch.distributed.launch provides)
-    import time as _time
 
-    code = 0
-    try:
-        while procs:
-            for p in list(procs):
-                rc = p.poll()
-                if rc is None:
-                    continue
-                procs.remove(p)
-                if rc != 0:
-                    code = code or rc
-                    for q in procs:
-                        q.terminate()
-            _time.sleep(0.1)
-    finally:
-        for q in procs:
-            if q.poll() is None:
-                q.kill()
+    for attempt in range(opt.max_restarts + 1):
+        code = _run_world(opt, attempt)
+        if code == 0:
+            return 0
+        if attempt < opt.max_restarts:
+            print(
+                f"[launch] world failed (rc={code}), restart "
+                f"{attempt + 1}/{opt.max_restarts}",
+                file=sys.stderr,
+                flush=True,
+            )
     return code
 
 

@@ -55,3 +55,54 @@ def test_launch_cli_two_ranks(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert os.path.exists(marker + "0") and os.path.exists(marker + "1")
+
+
+def test_launch_elastic_restart(tmp_path):
+    """--max_restarts relaunches the whole world after a rank failure
+    (elastic twin of torchrun --max-restarts): attempt 0 crashes rank 1,
+    attempt 1 succeeds; every rank sees GRAFT_RESTART_ATTEMPT."""
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, sys\n"
+        "attempt = int(os.environ['GRAFT_RESTART_ATTEMPT'])\n"
+        "rank = int(os.environ['RANK'])\n"
+        "if attempt == 0 and rank == 1:\n"
+        "    sys.exit(3)\n"
+        "open(os.environ['MARKER'] + f'{attempt}_{rank}', 'w').write('ok')\n"
+    )
+    env = dict(os.environ)
+    env["MARKER"] = str(tmp_path / "done_")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "pytorch_distributedtraining_tpu.runtime.launch",
+            "--nproc_per_node=2", "--max_restarts=2",
+            "--one_cpu_device_per_rank", str(script),
+        ],
+        env=env, capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "restart 1/2" in proc.stderr
+    # generation 1 completed on both ranks
+    assert os.path.exists(str(tmp_path / "done_1_0"))
+    assert os.path.exists(str(tmp_path / "done_1_1"))
+
+
+def test_launch_elastic_exhausted(tmp_path):
+    """A world that always fails exhausts its restart budget and reports
+    the child's exit code."""
+    script = tmp_path / "dead.py"
+    script.write_text("import sys; sys.exit(5)\n")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "pytorch_distributedtraining_tpu.runtime.launch",
+            "--nproc_per_node=2", "--max_restarts=1",
+            "--one_cpu_device_per_rank", str(script),
+        ],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 5
+    assert "restart 1/1" in proc.stderr
