@@ -1,0 +1,101 @@
+"""Summarize a jax.profiler chrome trace: top ops by device time.
+
+Give it the directory passed as ``GRAFT_BENCH_TRACE`` (bench.py writes a
+3-step steady-state trace there) and it aggregates `X` duration events per
+lane, preferring device lanes (TPU pids) over host lanes, so the MFU
+question — *which ops own the step time?* — is answerable without
+TensorBoard. Framework-internal python frames (``$file.py:line`` names)
+and the block_until_ready scaffolding are excluded.
+
+    python benchmarks/trace_summary.py /tmp/tpu_results/xplane --top 25
+
+One JSON line per op row plus a total line; also prints the share of the
+summed lane time each op owns.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+_SCAFFOLD = (
+    "block_until_ready", "try_to_block", "ThunkExecutor", "trace",
+    "stop_trace", "__exit__",
+)
+
+
+def load_events(trace_dir: str):
+    pats = [
+        os.path.join(trace_dir, "**", "*.trace.json.gz"),
+        os.path.join(trace_dir, "**", "*.trace.json"),
+    ]
+    files = sorted(
+        f for pat in pats for f in glob.glob(pat, recursive=True)
+    )
+    if not files:
+        raise SystemExit(f"no *.trace.json(.gz) under {trace_dir}")
+    opener = gzip.open if files[-1].endswith(".gz") else open
+    with opener(files[-1], "rb") as fh:
+        return json.loads(fh.read()).get("traceEvents", [])
+
+
+def summarize(events, top: int):
+    lanes = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            lanes[e["pid"]] = e.get("args", {}).get("name", str(e["pid"]))
+
+    device_pids = {
+        pid for pid, name in lanes.items()
+        if "host" not in (name or "").lower()
+    }
+    use_pids = device_pids or set(lanes)
+    dur = collections.Counter()
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in use_pids:
+            continue
+        name = e.get("name", "?")
+        if name.startswith("$") or any(s in name for s in _SCAFFOLD):
+            continue
+        # group fusion families: "copy_bitcast_fusion.142" -> one row
+        head, _, tail = name.rpartition(".")
+        if head and tail.isdigit():
+            name = head + ".*"
+        dur[name] += e.get("dur", 0.0)  # microseconds
+
+    total = sum(dur.values())
+    rows = [
+        {
+            "op": name,
+            "ms": round(v / 1e3, 3),
+            "share": round(v / total, 4) if total else 0.0,
+        }
+        for name, v in dur.most_common(top)
+    ]
+    return lanes, rows, total
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=25)
+    opt = ap.parse_args(argv)
+    events = load_events(opt.trace_dir)
+    lanes, rows, total = summarize(events, opt.top)
+    print(json.dumps({
+        "lanes": sorted(set(lanes.values())),
+        "total_op_ms": round(total / 1e3, 3),
+        "n_events": len(events),
+    }))
+    for r in rows:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -126,8 +126,14 @@ def _run_world(opt, attempt: int) -> int:
     """
     world = opt.nnodes * opt.nproc_per_node
     # fresh port per generation: the previous coordinator socket may
-    # linger in TIME_WAIT after a crash
-    port = opt.master_port or find_free_port()
+    # linger in TIME_WAIT after a crash — honor a pinned --master_port
+    # only for the first generation, else every retry would try to bind
+    # the very port the dead coordinator still holds
+    port = (
+        opt.master_port
+        if (opt.master_port and attempt == 0)
+        else find_free_port()
+    )
     procs = []
     for local_rank in range(opt.nproc_per_node):
         rank = opt.node_rank * opt.nproc_per_node + local_rank
