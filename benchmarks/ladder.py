@@ -227,9 +227,23 @@ def main(argv=None):
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", opt.virtual)
     configs = range(1, 6) if opt.all or opt.config is None else [opt.config]
+    code = 0
     for i in configs:
-        print(json.dumps(run_config(i, opt.tiny, opt.steps, opt.warmup)))
+        # failure-isolated: one config OOMing/crashing on the chip must
+        # not cost the remaining rungs' numbers
+        try:
+            print(json.dumps(run_config(i, opt.tiny, opt.steps, opt.warmup)),
+                  flush=True)
+        except Exception as e:  # noqa: BLE001 — per-config isolation
+            code = 1
+            print(json.dumps({
+                "config": i,
+                "error": f"{type(e).__name__}: {str(e)[:300]}",
+            }), flush=True)
+    return code
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(main())

@@ -195,6 +195,7 @@ def _rel_bias(module, n, h):
 
 
 def main():
+    failures = []
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         jax.config.update("jax_platforms", "cpu")  # sitecustomize latch
     if not TINY:  # the analytic model describes the full-size config only
@@ -247,18 +248,30 @@ def main():
     report("fwd", time_fn(fwd, params, batch))
 
     # --- model ablations (fwd+bwd, same shape of loss) -------------------
+    # failure-isolated: one arm blowing up on the chip (e.g. a Mosaic
+    # compile error in a Pallas variant) must not cost the later arms'
+    # data — the pool windows are too rare to burn
     def ablate(model_cls_kwargs, name):
-        m = SwinIR(dtype=jnp.bfloat16, **MODEL_KW, **model_cls_kwargs)
-        p = m.init(jax.random.PRNGKey(0), jnp.zeros((1, PATCH, PATCH, 3)))["params"]
+        try:
+            m = SwinIR(dtype=jnp.bfloat16, **MODEL_KW, **model_cls_kwargs)
+            p = m.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, PATCH, PATCH, 3))
+            )["params"]
 
-        @jax.jit
-        def fb(p, b):
-            def lfn(p):
-                out = m.apply({"params": p}, b[0])
-                return mse_loss(out, b[1])
-            return jax.value_and_grad(lfn)(p)
+            @jax.jit
+            def fb(p, b):
+                def lfn(p):
+                    out = m.apply({"params": p}, b[0])
+                    return mse_loss(out, b[1])
+                return jax.value_and_grad(lfn)(p)
 
-        report(name, time_fn(fb, p, batch))
+            report(name, time_fn(fb, p, batch))
+        except Exception as e:  # noqa: BLE001 — per-arm isolation
+            failures.append(name)
+            print(json.dumps({
+                "variant": name,
+                "error": f"{type(e).__name__}: {str(e)[:300]}",
+            }), flush=True)
 
     # -- attention-variant arms: patch the module-global class (flax wraps
     # __call__ at class creation, so assigning a raw function would lose
@@ -446,11 +459,14 @@ def main():
 
     # occupancy: 4x batch through the full step
     if TINY:
-        return
+        return 1 if failures else 0
     batch72 = make_batch(4 * BATCH)
     mesh2, state2, step2, _ = build_step(model, batch72)
     report("batch72", time_step(mesh2, state2, step2, batch72), batch=4 * BATCH)
+    return 1 if failures else 0
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(main())

@@ -31,6 +31,8 @@ _SCAFFOLD = (
 
 
 def load_events(trace_dir: str):
+    """All events from every trace file (multi-host dirs have one per
+    host); a bare .json whose .gz sibling exists is skipped, not doubled."""
     pats = [
         os.path.join(trace_dir, "**", "*.trace.json.gz"),
         os.path.join(trace_dir, "**", "*.trace.json"),
@@ -38,27 +40,56 @@ def load_events(trace_dir: str):
     files = sorted(
         f for pat in pats for f in glob.glob(pat, recursive=True)
     )
+    files = [f for f in files if not (
+        f.endswith(".json") and f + ".gz" in files
+    )]
     if not files:
         raise SystemExit(f"no *.trace.json(.gz) under {trace_dir}")
-    opener = gzip.open if files[-1].endswith(".gz") else open
-    with opener(files[-1], "rb") as fh:
-        return json.loads(fh.read()).get("traceEvents", [])
+    events = []
+    for f in files:
+        opener = gzip.open if f.endswith(".gz") else open
+        with opener(f, "rb") as fh:
+            events.extend(json.loads(fh.read()).get("traceEvents", []))
+    return events, len(files)
 
 
 def summarize(events, top: int):
-    lanes = {}
+    lanes, threads = {}, {}
     for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
             lanes[e["pid"]] = e.get("args", {}).get("name", str(e["pid"]))
+        elif e.get("name") == "thread_name":
+            threads[(e["pid"], e.get("tid"))] = e.get("args", {}).get(
+                "name", ""
+            )
 
     device_pids = {
         pid for pid, name in lanes.items()
         if "host" not in (name or "").lower()
     }
     use_pids = device_pids or set(lanes)
+    # TensorBoard-style device traces put several thread lanes under one
+    # pid ("XLA Modules" = whole-step envelopes, "Steps", "XLA Ops" = the
+    # individual ops). Counting the envelope lanes would double the total
+    # and halve every op's share — keep only op lanes when they exist.
+    op_tids = {
+        key for key, name in threads.items()
+        if key[0] in use_pids and "op" in (name or "").lower()
+    }
+
+    def _lane_ok(e):
+        if e.get("pid") not in use_pids:
+            return False
+        if op_tids:
+            return (e.get("pid"), e.get("tid")) in op_tids
+        name = threads.get((e.get("pid"), e.get("tid")), "")
+        return not any(s in name for s in ("Module", "Step"))
+
     dur = collections.Counter()
     for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in use_pids:
+        if e.get("ph") != "X" or not _lane_ok(e):
             continue
         name = e.get("name", "?")
         if name.startswith("$") or any(s in name for s in _SCAFFOLD):
@@ -86,12 +117,13 @@ def main(argv=None):
     ap.add_argument("trace_dir")
     ap.add_argument("--top", type=int, default=25)
     opt = ap.parse_args(argv)
-    events = load_events(opt.trace_dir)
+    events, n_files = load_events(opt.trace_dir)
     lanes, rows, total = summarize(events, opt.top)
     print(json.dumps({
         "lanes": sorted(set(lanes.values())),
         "total_op_ms": round(total / 1e3, 3),
         "n_events": len(events),
+        "n_trace_files": n_files,
     }))
     for r in rows:
         print(json.dumps(r))

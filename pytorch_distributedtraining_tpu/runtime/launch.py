@@ -206,6 +206,10 @@ def main(argv=None) -> int:
 
     if opt.max_restarts < 0:
         parser.error("--max_restarts must be >= 0 (torchrun rejects -1 too)")
+    if opt.nnodes > 1 and not opt.master_port:
+        # each node's launcher would otherwise probe its own random port
+        # and the cross-node rendezvous could never form
+        parser.error("--master_port is required when --nnodes > 1")
     if opt.max_restarts > 0 and opt.nnodes > 1:
         # each node's launcher only sees its local ranks; restarting one
         # node's generation while the others poll the dead collective can
