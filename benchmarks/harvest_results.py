@@ -1,0 +1,90 @@
+"""Render the TPU watcher chain's results directory as BASELINE.md rows.
+
+The outage watcher (`/tmp/tpu_chain.sh`) stages every on-chip benchmark
+and saves each stage's stdout as ``<stage>.txt`` under a results dir.
+This script turns that directory into a ready-to-append markdown section
+so the measured numbers reach BASELINE.md even when the pool window
+opens with nobody at the wheel:
+
+    python benchmarks/harvest_results.py /tmp/tpu_results >> BASELINE.md
+
+Only JSON lines are consumed; stages that are missing, empty, or
+error-only are listed as such rather than silently dropped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+STAGES = [
+    ("bench", "headline SwinIR-S x2 train step (bench.py, default knobs)"),
+    ("bench_pallas", "bench.py, GRAFT_BENCH_ATTN=pallas"),
+    ("bench_packed", "bench.py, pallas + attn_pack=2"),
+    ("bench_bf16ln", "bench.py, bf16 LayerNorms"),
+    ("bench_combo", "bench.py, pallas + pack + bf16 norms"),
+    ("bench_trace", "bench.py with op-trace capture"),
+    ("profile", "ablation profiler (profile_swinir.py)"),
+    ("facade", "facade vs TrainStep (facade_bench.py)"),
+    ("attn", "flash attention vs XLA (attn_bench.py)"),
+    ("offload", "optimizer-state host offload (offload_smoke.py)"),
+    ("decode", "GPT-2 decode throughput (decode_bench.py)"),
+    ("ladder", "five-config ladder (ladder.py --all)"),
+]
+
+
+def _json_lines(path: str):
+    rows = []
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return rows
+
+
+def render(results_dir: str) -> str:
+    out = [
+        "",
+        "### Harvested on-chip results "
+        f"({time.strftime('%Y-%m-%d %H:%M', time.gmtime())} UTC, "
+        "auto-collected by the outage watcher)",
+        "",
+    ]
+    for stage, desc in STAGES:
+        rows = _json_lines(os.path.join(results_dir, f"{stage}.txt"))
+        if rows is None:
+            out.append(f"- **{stage}** ({desc}): not run")
+            continue
+        if not rows:
+            out.append(f"- **{stage}** ({desc}): no JSON output")
+            continue
+        out.append(f"- **{stage}** ({desc}):")
+        for r in rows:
+            out.append(f"  - `{json.dumps(r)}`")
+    out.append("")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("results_dir")
+    opt = ap.parse_args(argv)
+    try:
+        print(render(opt.results_dir))
+    except BrokenPipeError:  # e.g. piped into head
+        pass
+
+
+if __name__ == "__main__":
+    main()

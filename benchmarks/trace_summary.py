@@ -45,6 +45,11 @@ def load_events(trace_dir: str):
     )]
     if not files:
         raise SystemExit(f"no *.trace.json(.gz) under {trace_dir}")
+    # one profiling RUN = one timestamped parent dir; merge only the
+    # newest run's files (multi-host: one file per host) — summing
+    # several runs would silently multiply every op time
+    newest_run = max(os.path.dirname(f) for f in files)
+    files = [f for f in files if os.path.dirname(f) == newest_run]
     events = []
     for f in files:
         opener = gzip.open if f.endswith(".gz") else open
@@ -74,9 +79,12 @@ def summarize(events, top: int):
     # pid ("XLA Modules" = whole-step envelopes, "Steps", "XLA Ops" = the
     # individual ops). Counting the envelope lanes would double the total
     # and halve every op's share — keep only op lanes when they exist.
+    # exact-lane match: a bare "op" substring would also catch envelope
+    # lanes like "TensorFlow Name Scope" and re-introduce double counting
     op_tids = {
         key for key, name in threads.items()
-        if key[0] in use_pids and "op" in (name or "").lower()
+        if key[0] in use_pids
+        and (name or "").lower().rstrip("s").endswith("op")
     }
 
     def _lane_ok(e):
