@@ -240,29 +240,19 @@ def torch_swinir_state_dict(params, *, model=None) -> dict:
     True)`` expects (``relative_position_index`` per block, ``attn_mask``
     on shifted blocks at the model's training ``img_size``).
     """
-    import re
-
-    import jax
     import torch
 
-    from .checkpoint import tree_to_flat_dict
     from .models.swinir import SWINIR_EXPORT_KEY_MAP
 
-    def to_torch_name(k: str) -> str:
-        for pat, repl in SWINIR_EXPORT_KEY_MAP:
-            k = re.sub(pat, repl, k)
-        k = k.replace("/", ".")
-        return re.sub(r"\.(kernel|scale)$", ".weight", k)
-
-    sd = {}
-    for k, v in tree_to_flat_dict(jax.device_get(params)).items():
-        a = np.asarray(v)
+    def fixup(k, a):
         if k.endswith("/kernel"):
             if a.ndim == 4:
-                a = np.transpose(a, (3, 2, 0, 1))  # HWIO -> OIHW
-            elif a.ndim == 2:
-                a = a.T  # [in, out] -> [out, in]
-        sd[to_torch_name(k)] = torch.from_numpy(np.array(a, copy=True))
+                return np.transpose(a, (3, 2, 0, 1))  # HWIO -> OIHW
+            if a.ndim == 2:
+                return a.T  # [in, out] -> [out, in]
+        return a
+
+    sd = _torch_export_state_dict(params, SWINIR_EXPORT_KEY_MAP, fixup)
 
     if model is not None:
         from .models.swinir import (
@@ -281,6 +271,67 @@ def torch_swinir_state_dict(params, *, model=None) -> dict:
                 if j % 2 == 1:  # shifted blocks carry the trained-size mask
                     sd[f"{base}.attn_mask"] = mask.clone()
     return sd
+
+
+def _torch_export_state_dict(params, key_rules, leaf_fixup) -> dict:
+    """Shared exporter core: flatten params, rename keys through
+    ``key_rules`` (+ the kernel/scale -> weight leaf twin), apply the
+    per-model ``leaf_fixup(flat_key, array) -> array`` layout conversion.
+    """
+    import re
+
+    import jax
+    import torch
+
+    from .checkpoint import tree_to_flat_dict
+
+    def to_torch_name(k: str) -> str:
+        for pat, repl in key_rules:
+            k = re.sub(pat, repl, k)
+        k = k.replace("/", ".")
+        return re.sub(r"\.(kernel|scale)$", ".weight", k)
+
+    sd = {}
+    for k, v in tree_to_flat_dict(jax.device_get(params)).items():
+        a = leaf_fixup(k, np.asarray(v))
+        sd[to_torch_name(k)] = torch.from_numpy(np.array(a, copy=True))
+    return sd
+
+
+def torch_gpt2_state_dict(params) -> dict:
+    """GPT-2 params -> HF ``GPT2LMHeadModel`` state_dict (torch tensors).
+
+    Inverse of ``models.gpt2.HF_KEY_MAP`` via ``GPT2_EXPORT_KEY_MAP``
+    (kept beside it in the model module). HF's linears are Conv1D modules
+    storing ``[in, out]`` — the flax Dense layout — so kernels export
+    untransposed (mirroring the ``conv1d_kernels=True`` load path), with
+    one exception: an untied ``lm_head`` is an ``nn.Linear`` ([out, in]),
+    so its kernel IS transposed. For tied models (the default, like
+    ``GPT2LMHeadModel`` itself) ``lm_head.weight`` is emitted as a copy of
+    ``wte``; the causal-mask ``attn.bias`` buffers are non-persistent in
+    current transformers and omitted.
+    """
+    from .models.gpt2 import GPT2_EXPORT_KEY_MAP
+
+    def fixup(k, a):
+        a = np.asarray(a, dtype=np.float32)
+        if k == "lm_head/kernel":
+            return a.T  # nn.Linear [out, in], unlike the Conv1D layers
+        return a
+
+    sd = _torch_export_state_dict(params, GPT2_EXPORT_KEY_MAP, fixup)
+    if "lm_head.weight" not in sd and "transformer.wte.weight" in sd:
+        sd["lm_head.weight"] = sd["transformer.wte.weight"].clone()
+    return sd
+
+
+def save_torch_gpt2(path: str, params) -> None:
+    """Write :func:`torch_gpt2_state_dict` as a ``.pth`` loadable by
+    ``GPT2LMHeadModel.load_state_dict`` — a model trained here drops back
+    into the HF ecosystem."""
+    import torch
+
+    torch.save(torch_gpt2_state_dict(params), path)
 
 
 def save_torch_swinir(

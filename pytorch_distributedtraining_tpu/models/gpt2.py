@@ -46,6 +46,24 @@ HF_KEY_MAP = [
     (r"^wpe/weight$", "wpe"),
 ]
 
+# Inverse direction (export, `interop.torch_gpt2_state_dict`): framework
+# flat keys -> HF ``GPT2LMHeadModel`` names. Kept next to HF_KEY_MAP so
+# the two directions evolve together (same convention as
+# ``swinir.SWINIR_EXPORT_KEY_MAP``). HF linears are Conv1D [in, out] —
+# the flax Dense layout — so kernels export untransposed, EXCEPT an
+# untied ``lm_head`` which is an nn.Linear [out, in] (handled by the
+# exporter's leaf fixup, not a key rule).
+GPT2_EXPORT_KEY_MAP = [
+    (r"^h_(\d+)/c_attn/", r"transformer.h.\1.attn.c_attn."),
+    (r"^h_(\d+)/c_proj/", r"transformer.h.\1.attn.c_proj."),
+    (r"^h_(\d+)/mlp_fc/", r"transformer.h.\1.mlp.c_fc."),
+    (r"^h_(\d+)/mlp_proj/", r"transformer.h.\1.mlp.c_proj."),
+    (r"^h_(\d+)/ln_(1|2)/", r"transformer.h.\1.ln_\2."),
+    (r"^ln_f/", "transformer.ln_f."),
+    (r"^wte$", "transformer.wte.weight"),
+    (r"^wpe$", "transformer.wpe.weight"),
+]
+
 
 @dataclass(frozen=True)
 class GPT2Config:

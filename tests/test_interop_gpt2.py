@@ -85,3 +85,69 @@ def test_hf_gpt2_missing_key_raises(hf_pair):
             flat_dict_to_tree(flat), template, key_map=HF_KEY_MAP,
             strict=True, conv1d_kernels=True,
         )
+
+
+def test_gpt2_export_loads_into_hf_and_matches_logits():
+    """Reverse direction: a model trained here exports a state_dict that a
+    REAL transformers GPT2LMHeadModel loads strict=True and reproduces our
+    logits — bidirectional interop like the SwinIR path."""
+    cfg = GPT2Config.tiny(vocab_size=256, n_positions=64, n_embd=32, n_head=2)
+    model = GPT2(cfg)
+    params = model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+
+    sd = interop.torch_gpt2_state_dict(params)
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=256, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    hf_model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    missing, unexpected = hf_model.load_state_dict(sd, strict=False)
+    # only non-persistent mask buffers may be absent; nothing unexpected
+    assert not unexpected, unexpected
+    assert all("bias" in k and "attn" in k or k == "lm_head.weight"
+               for k in missing), missing
+
+    tok = np.array([[3, 200, 41, 7, 99, 12, 0, 255]], dtype=np.int64)
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(tok)))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(tok)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)
+
+
+def test_gpt2_interop_round_trip():
+    """export -> import through HF_KEY_MAP recovers the exact params."""
+    cfg = GPT2Config.tiny(vocab_size=256, n_positions=64, n_embd=32, n_head=2)
+    model = GPT2(cfg)
+    params = model.init(
+        jax.random.PRNGKey(2), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    sd = interop.torch_gpt2_state_dict(params)
+    back = interop.load_torch_into_template(
+        interop._to_numpy_tree(sd), params, key_map=HF_KEY_MAP,
+        strict=True, conv1d_kernels=True,
+    )
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-6
+        )
+
+
+def test_gpt2_export_untied_lm_head():
+    """Untied models export the REAL trained head (transposed to HF's
+    nn.Linear layout), not a silent copy of wte."""
+    cfg = GPT2Config.tiny(
+        vocab_size=256, n_positions=64, n_embd=32, n_head=2,
+        tie_word_embeddings=False,
+    )
+    model = GPT2(cfg)
+    params = model.init(
+        jax.random.PRNGKey(4), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    sd = interop.torch_gpt2_state_dict(params)
+    kernel = np.asarray(params["lm_head"]["kernel"], np.float32)
+    np.testing.assert_allclose(sd["lm_head.weight"].numpy(), kernel.T)
+    assert not np.allclose(
+        sd["lm_head.weight"].numpy(), sd["transformer.wte.weight"].numpy()
+    )
