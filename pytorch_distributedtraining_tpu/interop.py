@@ -23,12 +23,38 @@ from .checkpoint import flat_dict_to_tree
 
 
 def load_torch_checkpoint(path: str) -> dict:
-    """torch.load a ``.pth``/``.pt`` file → nested dict of numpy arrays.
+    """Load a ``.pth``/``.pt``/``.safetensors`` file → nested numpy dict.
 
     Accepts the formats the reference uses: a flat ``state_dict`` (dotted
     torch keys become nesting) or a wrapper dict (e.g. ``{'params': ...}``,
-    `Stoke-DDP.py:209-211`) whose nesting is preserved.
+    `Stoke-DDP.py:209-211`) whose nesting is preserved. ``.safetensors``
+    (the format HF checkpoints ship today) is read without torch at all.
     """
+    if path.endswith(".safetensors"):
+        # the 8-byte-length + JSON header is part of the format: peek the
+        # dtypes to pick a reader deterministically (numpy has no bf16, so
+        # BF16 files need the torch reader) instead of masking real errors
+        # behind a try/except fallback
+        import json as _json
+        import struct
+
+        with open(path, "rb") as fh:
+            hlen = struct.unpack("<Q", fh.read(8))[0]
+            header = _json.loads(fh.read(hlen))
+        has_bf16 = any(
+            isinstance(v, dict) and v.get("dtype") in ("BF16", "F8_E4M3",
+                                                       "F8_E5M2")
+            for k, v in header.items() if k != "__metadata__"
+        )
+        if has_bf16:
+            from safetensors.torch import load_file as load_torch_file
+
+            flat = load_torch_file(path)
+        else:
+            from safetensors.numpy import load_file  # torch-free path
+
+            flat = load_file(path)
+        return _to_numpy_tree(dict(flat))
     import torch
 
     obj = torch.load(path, map_location="cpu", weights_only=True)
@@ -36,10 +62,15 @@ def load_torch_checkpoint(path: str) -> dict:
 
 
 def _to_numpy_tree(obj):
-    import torch
+    # torch import only when a torch leaf actually appears, so the
+    # numpy-safetensors path stays loadable in a torch-free environment
+    if type(obj).__module__.split(".")[0] == "torch":
+        import torch
 
-    if isinstance(obj, torch.Tensor):
-        return obj.detach().cpu().numpy()
+        if isinstance(obj, torch.Tensor):
+            if obj.dtype == torch.bfloat16:  # numpy has no bf16 — widen
+                obj = obj.float()
+            return obj.detach().cpu().numpy()
     if isinstance(obj, dict):
         out = {}
         for k, v in obj.items():
@@ -321,7 +352,9 @@ def torch_gpt2_state_dict(params) -> dict:
 
     sd = _torch_export_state_dict(params, GPT2_EXPORT_KEY_MAP, fixup)
     if "lm_head.weight" not in sd and "transformer.wte.weight" in sd:
-        sd["lm_head.weight"] = sd["transformer.wte.weight"].clone()
+        # same tensor object, not a clone: torch.save dedups shared
+        # storage (HF's own tying), halving the embedding bytes on disk
+        sd["lm_head.weight"] = sd["transformer.wte.weight"]
     return sd
 
 

@@ -151,3 +151,40 @@ def test_gpt2_export_untied_lm_head():
     assert not np.allclose(
         sd["lm_head.weight"].numpy(), sd["transformer.wte.weight"].numpy()
     )
+
+
+def test_safetensors_checkpoint_loads(tmp_path):
+    """HF checkpoints ship .safetensors today; the loader reads them
+    (incl. a bf16 file via the torch reader fallback) into the same
+    nested numpy tree as a .pth."""
+    from safetensors.torch import save_file
+
+    cfg = GPT2Config.tiny(vocab_size=256, n_positions=64, n_embd=32, n_head=2)
+    model = GPT2(cfg)
+    template = model.init(
+        jax.random.PRNGKey(5), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    sd = interop.torch_gpt2_state_dict(template)
+    sd = {k: v.contiguous() for k, v in sd.items()}
+    # tied lm_head shares storage semantics in HF saves; drop like HF does
+    sd.pop("lm_head.weight")
+
+    f32 = str(tmp_path / "model.safetensors")
+    save_file(sd, f32)
+    params = interop.load_torch_into_template(
+        interop.load_torch_checkpoint(f32), template,
+        key_map=HF_KEY_MAP, strict=True, conv1d_kernels=True,
+    )
+    for a, b in zip(jax.tree.leaves(template), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=1e-6)
+
+    bf16 = str(tmp_path / "model_bf16.safetensors")
+    save_file({k: v.bfloat16() for k, v in sd.items()}, bf16)
+    params2 = interop.load_torch_into_template(
+        interop.load_torch_checkpoint(bf16), template,
+        key_map=HF_KEY_MAP, strict=True, conv1d_kernels=True,
+    )
+    for a, b in zip(jax.tree.leaves(template), jax.tree.leaves(params2)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=1e-2)
