@@ -68,7 +68,11 @@ def _to_numpy_tree(obj):
         import torch
 
         if isinstance(obj, torch.Tensor):
-            if obj.dtype == torch.bfloat16:  # numpy has no bf16 — widen
+            widen = {torch.bfloat16} | {
+                dt for name in ("float8_e4m3fn", "float8_e5m2")
+                if (dt := getattr(torch, name, None)) is not None
+            }
+            if obj.dtype in widen:  # numpy has no bf16/f8 — widen
                 obj = obj.float()
             return obj.detach().cpu().numpy()
     if isinstance(obj, dict):
@@ -329,7 +333,7 @@ def _torch_export_state_dict(params, key_rules, leaf_fixup) -> dict:
     return sd
 
 
-def torch_gpt2_state_dict(params) -> dict:
+def torch_gpt2_state_dict(params, *, tie_storage: bool = False) -> dict:
     """GPT-2 params -> HF ``GPT2LMHeadModel`` state_dict (torch tensors).
 
     Inverse of ``models.gpt2.HF_KEY_MAP`` via ``GPT2_EXPORT_KEY_MAP``
@@ -338,9 +342,13 @@ def torch_gpt2_state_dict(params) -> dict:
     untransposed (mirroring the ``conv1d_kernels=True`` load path), with
     one exception: an untied ``lm_head`` is an ``nn.Linear`` ([out, in]),
     so its kernel IS transposed. For tied models (the default, like
-    ``GPT2LMHeadModel`` itself) ``lm_head.weight`` is emitted as a copy of
-    ``wte``; the causal-mask ``attn.bias`` buffers are non-persistent in
-    current transformers and omitted.
+    ``GPT2LMHeadModel`` itself) ``lm_head.weight`` is emitted as an
+    independent copy of ``wte`` — safe for ``safetensors.torch.save_file``
+    (which rejects shared storage) and for in-place edits;
+    ``tie_storage=True`` makes it the SAME tensor object so ``torch.save``
+    dedups the embedding on disk (HF's own tying; :func:`save_torch_gpt2`
+    uses that). The causal-mask ``attn.bias`` buffers are non-persistent
+    in current transformers and omitted.
     """
     from .models.gpt2 import GPT2_EXPORT_KEY_MAP
 
@@ -352,19 +360,19 @@ def torch_gpt2_state_dict(params) -> dict:
 
     sd = _torch_export_state_dict(params, GPT2_EXPORT_KEY_MAP, fixup)
     if "lm_head.weight" not in sd and "transformer.wte.weight" in sd:
-        # same tensor object, not a clone: torch.save dedups shared
-        # storage (HF's own tying), halving the embedding bytes on disk
-        sd["lm_head.weight"] = sd["transformer.wte.weight"]
+        wte = sd["transformer.wte.weight"]
+        sd["lm_head.weight"] = wte if tie_storage else wte.clone()
     return sd
 
 
 def save_torch_gpt2(path: str, params) -> None:
     """Write :func:`torch_gpt2_state_dict` as a ``.pth`` loadable by
     ``GPT2LMHeadModel.load_state_dict`` — a model trained here drops back
-    into the HF ecosystem."""
+    into the HF ecosystem. Tied weights share storage in the file
+    (``torch.save`` dedups them, like HF's own checkpoints)."""
     import torch
 
-    torch.save(torch_gpt2_state_dict(params), path)
+    torch.save(torch_gpt2_state_dict(params, tie_storage=True), path)
 
 
 def save_torch_swinir(

@@ -123,3 +123,50 @@ ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
 ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
 ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock)
 ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=BottleneckBlock)
+
+
+def torchvision_key_map(
+    stage_sizes: Sequence[int], block_cls: ModuleDef
+) -> list:
+    """(regex, repl) table from torchvision ResNet state_dict naming
+    (``layer{i}.{j}.conv{k}.weight`` / ``downsample.{0,1}`` / ``fc``) onto
+    this module tree, for ``interop.load_torch_into_template`` with a
+    ``{"params": ..., "batch_stats": ...}`` template (``param_key=None``).
+
+    torchvision numbers blocks per stage; flax numbers module instances
+    globally — regex alone can't do that arithmetic, so the table is
+    generated per architecture. Leaf twins (weight->kernel/scale,
+    OIHW->HWIO, running_mean->mean) are handled downstream by interop's
+    heuristics; BN running stats are routed into the ``batch_stats``
+    collection here.
+    """
+    block = (
+        "BottleneckBlock" if block_cls is BottleneckBlock else "BasicBlock"
+    )
+    convs = 3 if block == "BottleneckBlock" else 2
+    rules: list = [
+        (r"(^|/)num_batches_tracked$", None),  # torch-only counter
+        (r"^conv1/", "conv_init/"),
+        (r"^bn1/", "bn_init/"),
+        (r"^fc/", "head/"),
+    ]
+    g = 0
+    for i, n in enumerate(stage_sizes):
+        for j in range(n):
+            bt, bf = f"layer{i + 1}/{j}", f"{block}_{g}"
+            for c in range(convs):
+                rules.append((rf"^{bt}/conv{c + 1}/", f"{bf}/Conv_{c}/"))
+                rules.append((rf"^{bt}/bn{c + 1}/", f"{bf}/BatchNorm_{c}/"))
+            rules.append((rf"^{bt}/downsample/0/", f"{bf}/conv_proj/"))
+            rules.append((rf"^{bt}/downsample/1/", f"{bf}/norm_proj/"))
+            g += 1
+    # collection routing LAST, on the renamed paths
+    rules.append(
+        (r"^(.*)/(running_mean|running_var)$", r"batch_stats/\1/\2")
+    )
+    rules.append((r"^(?!batch_stats/)", "params/"))
+    return rules
+
+
+RESNET18_KEY_MAP = torchvision_key_map((2, 2, 2, 2), BasicBlock)
+RESNET50_KEY_MAP = torchvision_key_map((3, 4, 6, 3), BottleneckBlock)
