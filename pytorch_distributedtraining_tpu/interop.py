@@ -61,6 +61,19 @@ def load_torch_checkpoint(path: str) -> dict:
     return _to_numpy_tree(obj)
 
 
+def _numpy_incompatible_dtypes(torch):
+    global _WIDEN_DTYPES
+    if _WIDEN_DTYPES is None:
+        _WIDEN_DTYPES = {torch.bfloat16} | {
+            dt for name in ("float8_e4m3fn", "float8_e5m2")
+            if (dt := getattr(torch, name, None)) is not None
+        }
+    return _WIDEN_DTYPES
+
+
+_WIDEN_DTYPES = None
+
+
 def _to_numpy_tree(obj):
     # torch import only when a torch leaf actually appears, so the
     # numpy-safetensors path stays loadable in a torch-free environment
@@ -68,12 +81,8 @@ def _to_numpy_tree(obj):
         import torch
 
         if isinstance(obj, torch.Tensor):
-            widen = {torch.bfloat16} | {
-                dt for name in ("float8_e4m3fn", "float8_e5m2")
-                if (dt := getattr(torch, name, None)) is not None
-            }
-            if obj.dtype in widen:  # numpy has no bf16/f8 — widen
-                obj = obj.float()
+            if obj.dtype in _numpy_incompatible_dtypes(torch):
+                obj = obj.float()  # numpy has no bf16/f8 — widen
             return obj.detach().cpu().numpy()
     if isinstance(obj, dict):
         out = {}

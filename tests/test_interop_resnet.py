@@ -28,7 +28,7 @@ from pytorch_distributedtraining_tpu.models.resnet import (  # noqa: E402
 )
 
 
-def _to_torch_name(flat_key: str, stage_sizes, convs: int) -> str:
+def _to_torch_name(flat_key: str, stage_sizes) -> str:
     """Inverse of torchvision_key_map for the test's synthesis step."""
     import re
 
@@ -61,7 +61,7 @@ def _to_torch_name(flat_key: str, stage_sizes, convs: int) -> str:
     return k
 
 
-def _synthesize(variables, stage_sizes, convs):
+def _synthesize(variables, stage_sizes):
     """torchvision-named state_dict whose values are template + 0.5, in
     torch layouts (OIHW convs, [out,in] linear)."""
     sd = {}
@@ -69,7 +69,7 @@ def _synthesize(variables, stage_sizes, convs):
         a = np.asarray(v, np.float32) + 0.5
         if k.endswith("/kernel"):
             a = np.transpose(a, (3, 2, 0, 1)) if a.ndim == 4 else a.T
-        name = _to_torch_name(k, stage_sizes, convs)
+        name = _to_torch_name(k, stage_sizes)
         sd[name] = torch.from_numpy(a)
         if name.endswith("running_var"):  # every BN carries the counter
             sd[name.replace("running_var", "num_batches_tracked")] = (
@@ -79,14 +79,14 @@ def _synthesize(variables, stage_sizes, convs):
 
 
 @pytest.mark.parametrize(
-    "ctor,key_map,stage_sizes,convs",
+    "ctor,key_map,stage_sizes",
     [
-        (ResNet18, RESNET18_KEY_MAP, (2, 2, 2, 2), 2),
-        (ResNet50, RESNET50_KEY_MAP, (3, 4, 6, 3), 3),
+        (ResNet18, RESNET18_KEY_MAP, (2, 2, 2, 2)),
+        (ResNet50, RESNET50_KEY_MAP, (3, 4, 6, 3)),
     ],
     ids=["resnet18", "resnet50"],
 )
-def test_torchvision_state_dict_loads(ctor, key_map, stage_sizes, convs):
+def test_torchvision_state_dict_loads(ctor, key_map, stage_sizes):
     model = ctor(num_classes=10)
     variables = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
@@ -95,7 +95,7 @@ def test_torchvision_state_dict_loads(ctor, key_map, stage_sizes, convs):
         "params": variables["params"],
         "batch_stats": variables["batch_stats"],
     }
-    sd = _synthesize(template, stage_sizes, convs)
+    sd = _synthesize(template, stage_sizes)
     # nested form, exactly what load_torch_checkpoint would produce
     src = interop._to_numpy_tree(sd)
     loaded = interop.load_torch_into_template(
@@ -123,7 +123,7 @@ def test_missing_block_key_raises_strict():
         "params": variables["params"],
         "batch_stats": variables["batch_stats"],
     }
-    sd = _synthesize(template, (2, 2, 2, 2), 2)
+    sd = _synthesize(template, (2, 2, 2, 2))
     sd.pop("layer1.0.conv1.weight")
     with pytest.raises(Exception, match="missing"):
         interop.load_torch_into_template(

@@ -106,3 +106,82 @@ def test_launch_elastic_exhausted(tmp_path):
     )
     assert proc.returncode == 5
     assert "restart 1/1" in proc.stderr
+
+
+def test_elastic_restart_resumes_from_checkpoint(tmp_path):
+    """The full recovery story: rank 1 crashes mid-training on attempt 0,
+    the launcher relaunches the world, and attempt 1 restores the saved
+    train state and continues from the crash step (torchrun-elastic +
+    preemption-checkpoint integration, SURVEY §5 failure handling)."""
+    script = tmp_path / "resumable.py"
+    script.write_text(
+        "import os, sys\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "jax.config.update('jax_compilation_cache_dir',\n"
+        "                  f'/tmp/jax_test_compile_cache_{os.getuid()}')\n"
+        "from pytorch_distributedtraining_tpu.runtime import dist\n"
+        "dist.initialize()\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import multihost_utils\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from pytorch_distributedtraining_tpu import checkpoint_sharded, optim\n"
+        "from pytorch_distributedtraining_tpu.losses import mse_loss\n"
+        "from pytorch_distributedtraining_tpu.models import Net\n"
+        "from pytorch_distributedtraining_tpu.parallel import (\n"
+        "    DDP, TrainStep, create_train_state)\n"
+        "from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh\n"
+        "attempt = int(os.environ['GRAFT_RESTART_ATTEMPT'])\n"
+        "rank = dist.process_index()\n"
+        "mesh = make_mesh(MeshSpec(dp=2))\n"
+        "model = Net(upscale_factor=2)\n"
+        "tx = optim.adamw(lr=3e-3)\n"
+        "def loss_fn(p, b, r, ms):\n"
+        "    li, hi = b\n"
+        "    return mse_loss(model.apply({'params': p}, li), hi), {}\n"
+        "state, sh = create_train_state(\n"
+        "    init_fn=lambda r: (model.init(r, jnp.zeros((1, 8, 8, 3)))['params'], {}),\n"
+        "    tx=tx, mesh=mesh, policy=DDP())\n"
+        "ckpt = os.environ['CKPT_DIR']\n"
+        "start = 0\n"
+        "if attempt > 0 and os.path.isdir(ckpt):\n"
+        "    state = checkpoint_sharded.restore_sharded(ckpt, state)\n"
+        "    start = int(state.step)\n"
+        "    assert start == 2, start  # resumed exactly at the crash point\n"
+        "step = TrainStep(loss_fn, tx, mesh, DDP(), state_shardings=sh,\n"
+        "                 donate=False)\n"
+        "rng = np.random.default_rng(0)\n"
+        "hr = rng.random((8, 16, 16, 3)).astype(np.float32)\n"
+        "lr = hr.reshape(8, 8, 2, 8, 2, 3).mean(axis=(2, 4))\n"
+        "batch = tuple(multihost_utils.host_local_array_to_global_array(\n"
+        "    x[rank * 4:(rank + 1) * 4], mesh, P('dp')) for x in (lr, hr))\n"
+        "with mesh:\n"
+        "    for i in range(start, 5):\n"
+        "        state, m = step(state, batch)\n"
+        "        if i == 1:\n"
+        "            checkpoint_sharded.save_sharded(ckpt, state, force=True)\n"
+        "            if attempt == 0 and rank == 1:\n"
+        "                os._exit(17)  # hard preemption: no teardown\n"
+        "assert int(state.step) == 5, int(state.step)\n"
+        "open(os.environ['MARKER'] + f'{attempt}_{rank}', 'w').write(\n"
+        "    str(float(m['loss'])))\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MARKER"] = str(tmp_path / "done_")
+    env["CKPT_DIR"] = str(tmp_path / "ckpt")
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "pytorch_distributedtraining_tpu.runtime.launch",
+            "--nproc_per_node=2", "--max_restarts=1",
+            "--one_cpu_device_per_rank", str(script),
+        ],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, (proc.stderr[-3000:], proc.stdout[-500:])
+    assert "restart 1/1" in proc.stderr
+    for r in range(2):
+        assert os.path.exists(str(tmp_path / f"done_1_{r}"))
