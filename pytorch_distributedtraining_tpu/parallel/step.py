@@ -239,6 +239,19 @@ class TrainStep:
 
         jax.debug.callback(raise_on_bad, flags, loss_ok)
 
+    def precompile(self, state: TrainState, batch, lr_factor: float = 1.0):
+        """Compile the step without executing it.
+
+        With the persistent compilation cache enabled the artifact lands
+        on disk, so the first real call is a fast deserialize. Use before
+        ``runtime.dist.coordination_barrier`` in multi-process runs: it
+        takes per-rank compile skew out of the first collective's window
+        (Gloo's context bootstrap has a fixed ~30 s timeout that compile
+        skew on oversubscribed hosts can exceed).
+        """
+        with self.mesh:
+            self._jitted.lower(state, batch, jnp.float32(lr_factor)).compile()
+
     def __call__(self, state: TrainState, batch, lr_factor: float = 1.0):
         return self._jitted(state, batch, jnp.float32(lr_factor))
 

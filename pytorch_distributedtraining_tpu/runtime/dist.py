@@ -137,6 +137,25 @@ def initialize(
     )
 
 
+def coordination_barrier(name: str = "sync", timeout_s: float = 600.0) -> None:
+    """Process-level barrier over the coordination service (pure gRPC).
+
+    Unlike ``ops.barrier`` (a device collective), this never touches the
+    collectives transport — so it is safe BEFORE the first collective.
+    That matters on oversubscribed hosts: Gloo's context bootstrap has a
+    fixed ~30 s KV timeout, and per-rank compile/import skew can exceed it
+    (the 4-rank localhost harness on a 1-core box does). Compile first,
+    barrier here, then step — ranks enter the Gloo exchange aligned.
+    No-op when the distributed client isn't initialized.
+    """
+    from jax._src import distributed as _jd
+
+    client = _jd.global_state.client
+    if client is None:
+        return
+    client.wait_at_barrier(name, timeout_in_ms=int(timeout_s * 1000))
+
+
 def shutdown() -> None:
     """Tear down coordination — twin of ``dist.destroy_process_group()``
     (`/root/reference/Fairscale-DDP.py:109`)."""

@@ -155,6 +155,8 @@ def test_elastic_restart_resumes_from_checkpoint(tmp_path):
         "lr = hr.reshape(8, 8, 2, 8, 2, 3).mean(axis=(2, 4))\n"
         "batch = tuple(multihost_utils.host_local_array_to_global_array(\n"
         "    x[rank * 4:(rank + 1) * 4], mesh, P('dp')) for x in (lr, hr))\n"
+        "step.precompile(state, batch)\n"
+        "dist.coordination_barrier('compiled')\n"
         "with mesh:\n"
         "    for i in range(start, 5):\n"
         "        state, m = step(state, batch)\n"

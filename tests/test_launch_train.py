@@ -83,6 +83,12 @@ state, shardings = create_train_state(
 step = TrainStep(loss_fn, tx, mesh, DDP(), state_shardings=shardings,
                  donate=False)
 
+# compile BEFORE the first collective, then align ranks on the pure-gRPC
+# coordination barrier: per-rank compile skew on an oversubscribed host
+# can exceed Gloo's fixed ~30s context-bootstrap timeout
+step.precompile(state, global_batch(0))
+dist.coordination_barrier("compiled")
+
 losses = []
 with mesh:
     for i in range(4):
