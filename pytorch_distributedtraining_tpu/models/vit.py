@@ -117,3 +117,26 @@ class ViT(nn.Module):
 
 
 ViTB16 = partial(ViT, cfg=ViTConfig.b16())
+
+# (regex, repl) rewrites from torchvision's ``vit_b_16`` state_dict naming
+# onto this module tree, for ``interop.load_torch_into_template``. Flat
+# "/"-joined keys; leaf twins (weight->kernel, OIHW->HWIO, [out,in]->[in,
+# out]) are handled downstream by interop's heuristics. torchvision's
+# ``self_attention`` is an nn.MultiheadAttention whose packed
+# ``in_proj_weight`` is [3d, d] rows stacked [q;k;v] — transposed it is
+# exactly this model's ``c_attn`` [d, 3d] column order (split thirds);
+# its MLPBlock is Sequential(Linear, GELU, Dropout, Linear, Dropout),
+# hence the 0/3 indices.
+VIT_KEY_MAP = [
+    (r"^class_token$", "cls"),
+    (r"^conv_proj/", "patch_embed/"),
+    (r"^encoder/pos_embedding$", "pos_embed"),
+    (r"^encoder/layers/encoder_layer_(\d+)/", r"encoder_\1/"),
+    (r"/self_attention/in_proj_weight$", "/c_attn/kernel"),
+    (r"/self_attention/in_proj_bias$", "/c_attn/bias"),
+    (r"/self_attention/out_proj/", "/c_proj/"),
+    (r"/mlp/0/", "/mlp_fc/"),
+    (r"/mlp/3/", "/mlp_proj/"),
+    (r"^encoder/ln/", "ln_f/"),
+    (r"^heads/head/", "head/"),
+]

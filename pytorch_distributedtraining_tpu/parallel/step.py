@@ -249,6 +249,17 @@ class TrainStep:
         (Gloo's context bootstrap has a fixed ~30 s timeout that compile
         skew on oversubscribed hosts can exceed).
         """
+        if not jax.config.jax_compilation_cache_dir:
+            import warnings
+
+            warnings.warn(
+                "TrainStep.precompile without jax_compilation_cache_dir: "
+                "the AOT artifact is discarded and the first real step "
+                "recompiles — enable the persistent compilation cache for "
+                "precompile to pay off",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         with self.mesh:
             self._jitted.lower(state, batch, jnp.float32(lr_factor)).compile()
 

@@ -120,6 +120,7 @@ def test_elastic_restart_resumes_from_checkpoint(tmp_path):
         "import jax\n"
         "jax.config.update('jax_compilation_cache_dir',\n"
         "                  f'/tmp/jax_test_compile_cache_{os.getuid()}')\n"
+        "jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0)\n"
         "from pytorch_distributedtraining_tpu.runtime import dist\n"
         "dist.initialize()\n"
         "import jax.numpy as jnp\n"
