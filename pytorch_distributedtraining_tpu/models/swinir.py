@@ -93,6 +93,20 @@ TORCH_KEY_MAP = [
     (r"^upsample/0/", "conv_up/"),  # UpsampleOneStep = Sequential(Conv, PS)
 ]
 
+# Classical SwinIR-M checkpoints (upsampler='pixelshuffle') use a different
+# tail: Sequential(conv, LeakyReLU) before upsampling, then the official
+# Upsample module interleaving convs (even indices) with parameter-free
+# PixelShuffles — so ``upsample/0`` means a different module than in the
+# -S map above and the two families need separate tables.
+TORCH_KEY_MAP_CLASSICAL = [
+    rule for rule in TORCH_KEY_MAP if not rule[0].startswith("^upsample")
+] + [
+    (r"^conv_before_upsample/0/", "conv_before_up/"),
+    (r"^upsample/0/", "up_conv_0/"),
+    (r"^upsample/2/", "up_conv_1/"),
+    (r"^upsample/4/", "up_conv_2/"),  # up to x8
+]
+
 # Inverse direction (export): framework flat keys -> official torch names.
 # Kept next to TORCH_KEY_MAP so the two directions evolve together; the
 # leaf twins (kernel->weight + layout) are handled by interop's exporter.
@@ -105,6 +119,12 @@ SWINIR_EXPORT_KEY_MAP = [
     (r"^rstb_(\d+)/conv/", r"layers.\1.conv."),
     (r"^patch_norm/", "patch_embed.norm."),
     (r"^conv_up/", "upsample.0."),
+    # classical 'pixelshuffle' tail (source names are disjoint from the
+    # -S tail's, so one export table serves both families)
+    (r"^conv_before_up/", "conv_before_upsample.0."),
+    (r"^up_conv_0/", "upsample.0."),
+    (r"^up_conv_1/", "upsample.2."),
+    (r"^up_conv_2/", "upsample.4."),
 ]
 
 
@@ -292,9 +312,10 @@ class SwinIR(nn.Module):
 
     @nn.compact
     def __call__(self, x):  # [B, H, W, C] in [0, img_range]
-        if self.upsampler != "pixelshuffledirect":
+        if self.upsampler not in ("pixelshuffledirect", "pixelshuffle"):
             raise NotImplementedError(
-                "only upsampler='pixelshuffledirect' (SwinIR-S) is implemented"
+                "upsampler must be 'pixelshuffledirect' (SwinIR-S) or "
+                "'pixelshuffle' (classical SwinIR-M)"
             )
         mean = jnp.asarray([0.4488, 0.4371, 0.4040], x.dtype) * self.img_range
         b, h, w, c = x.shape
@@ -331,13 +352,46 @@ class SwinIR(nn.Module):
         )(y)
         feat = feat + y
 
-        # pixelshuffledirect: one conv to C*r^2 then depth-to-space
         r = self.upscale
-        out = nn.Conv(
-            self.in_chans * r * r, (3, 3), padding="SAME", dtype=self.dtype,
-            name="conv_up",
-        )(feat)
-        out = pixel_shuffle(out, r)
+        if self.upsampler == "pixelshuffledirect":
+            # one conv to C*r^2 then depth-to-space (SwinIR-S)
+            out = nn.Conv(
+                self.in_chans * r * r, (3, 3), padding="SAME",
+                dtype=self.dtype, name="conv_up",
+            )(feat)
+            out = pixel_shuffle(out, r)
+        else:
+            # classical SwinIR-M: widen to num_feat=64, staged x2 shuffles
+            # (or one x3), then a final conv — the official module tree
+            # (conv_before_upsample.0 / upsample.2k / conv_last)
+            nf = 64
+            y = nn.Conv(
+                nf, (3, 3), padding="SAME", dtype=self.dtype,
+                name="conv_before_up",
+            )(feat)
+            y = nn.leaky_relu(y, negative_slope=0.01)
+            if r & (r - 1) == 0:  # power of two: log2(r) stages of x2
+                for s in range(r.bit_length() - 1):
+                    y = nn.Conv(
+                        4 * nf, (3, 3), padding="SAME", dtype=self.dtype,
+                        name=f"up_conv_{s}",
+                    )(y)
+                    y = pixel_shuffle(y, 2)
+            elif r == 3:
+                y = nn.Conv(
+                    9 * nf, (3, 3), padding="SAME", dtype=self.dtype,
+                    name="up_conv_0",
+                )(y)
+                y = pixel_shuffle(y, 3)
+            else:
+                raise NotImplementedError(
+                    f"pixelshuffle upsampler supports scales 2^n and 3, "
+                    f"got {r}"
+                )
+            out = nn.Conv(
+                self.in_chans, (3, 3), padding="SAME", dtype=self.dtype,
+                name="conv_last",
+            )(y)
         out = out.astype(jnp.float32) * self.img_range + mean
         if pad_h or pad_w:
             out = out[:, : h * r, : w * r, :]

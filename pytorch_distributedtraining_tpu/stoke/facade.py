@@ -792,10 +792,19 @@ class Stoke:
 
             if isinstance(self._module, _SwinIR):
                 # the reference's own checkpoint family loads unmodified
-                # (`Stoke-DDP.py:209-213` -> torch-SwinIR state_dict naming)
-                from ..models.swinir import TORCH_KEY_MAP
+                # (`Stoke-DDP.py:209-213` -> torch-SwinIR state_dict naming);
+                # the classical 'pixelshuffle' tail names its upsample
+                # modules differently, so the map follows the model config
+                from ..models.swinir import (
+                    TORCH_KEY_MAP,
+                    TORCH_KEY_MAP_CLASSICAL,
+                )
 
-                key_map = TORCH_KEY_MAP
+                key_map = (
+                    TORCH_KEY_MAP_CLASSICAL
+                    if self._module.upsampler == "pixelshuffle"
+                    else TORCH_KEY_MAP
+                )
         if isinstance(source, str):
             if source.endswith((".pth", ".pt")):
                 from ..interop import (

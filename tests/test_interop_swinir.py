@@ -10,6 +10,7 @@ strict load through the facade reproduces the source model bit-for-bit.
 import re
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -146,3 +147,96 @@ def test_export_round_trip_through_torch_format(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     out = np.asarray(s.model(x))
     np.testing.assert_allclose(out, np.asarray(ref_out), atol=2e-5)
+
+
+def test_classical_pixelshuffle_upsampler_loads(tmp_path):
+    """SwinIR-M family (upsampler='pixelshuffle', x4): official naming
+    (conv_before_upsample.0 / upsample.{0,2} convs / conv_last) strict-
+    loads through TORCH_KEY_MAP_CLASSICAL and upscales 4x."""
+    import re
+
+    from pytorch_distributedtraining_tpu.models.swinir import (
+        TORCH_KEY_MAP_CLASSICAL,
+    )
+
+    kw = dict(depths=[2], embed_dim=12, num_heads=[2], window_size=4,
+              upscale=4, upsampler="pixelshuffle")
+    model = SwinIR(**kw)
+    x = jnp.zeros((1, 16, 16, 3))
+    template = model.init(jax.random.key(0), x)["params"]
+
+    def to_torch(k):
+        k = re.sub(r"^rstb_(\d+)/layer_(\d+)/",
+                   r"layers.\1.residual_group.blocks.\2.", k)
+        k = re.sub(r"^rstb_(\d+)/conv/", r"layers.\1.conv.", k)
+        k = k.replace("/fc1/", "/mlp.fc1/").replace("/fc2/", "/mlp.fc2/")
+        k = re.sub(r"^patch_norm/", "patch_embed.norm.", k)
+        k = re.sub(r"^conv_before_up/", "conv_before_upsample.0.", k)
+        k = re.sub(r"^up_conv_0/", "upsample.0.", k)
+        k = re.sub(r"^up_conv_1/", "upsample.2.", k)
+        k = k.replace("/", ".")
+        k = re.sub(r"\.(kernel|scale)$", ".weight", k)
+        return k
+
+    import torch
+
+    from pytorch_distributedtraining_tpu.checkpoint import tree_to_flat_dict
+
+    sd = {}
+    for k, v in tree_to_flat_dict(template).items():
+        a = np.array(np.asarray(v, np.float32) + 0.25, copy=True)
+        if k.endswith("/kernel"):
+            a = np.ascontiguousarray(
+                np.transpose(a, (3, 2, 0, 1)) if a.ndim == 4 else a.T
+            )
+        sd[to_torch(k)] = torch.from_numpy(a)
+
+    from pytorch_distributedtraining_tpu import interop
+
+    loaded = interop.load_torch_into_template(
+        interop._to_numpy_tree(sd), template,
+        key_map=TORCH_KEY_MAP_CLASSICAL, strict=True,
+    )
+    for a, b in zip(jax.tree.leaves(loaded), jax.tree.leaves(template)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b, np.float32) + 0.25, atol=1e-6
+        )
+    out = model.apply({"params": loaded}, jnp.ones((1, 16, 16, 3)) * 0.5)
+    assert out.shape == (1, 64, 64, 3)  # x4
+
+
+def test_classical_export_round_trip_and_facade_load(tmp_path):
+    """Bidirectional for the classical family too: save_torch_swinir emits
+    official names (conv_before_upsample.0/upsample.0/upsample.2), and the
+    facade auto-selects TORCH_KEY_MAP_CLASSICAL for pixelshuffle models."""
+    from pytorch_distributedtraining_tpu import interop
+
+    kw = dict(depths=[2], embed_dim=12, num_heads=[2], window_size=4,
+              upscale=4, upsampler="pixelshuffle")
+    model = SwinIR(**kw)
+    x = jnp.zeros((2, 16, 16, 3))
+    params = model.init(jax.random.key(1), x)["params"]
+
+    path = str(tmp_path / "classical_x4.pth")
+    interop.save_torch_swinir(path, params)
+    sd = torch.load(path, weights_only=True)["params"]
+    assert "conv_before_upsample.0.weight" in sd
+    assert "upsample.0.weight" in sd and "upsample.2.weight" in sd
+    assert not any(k.startswith(("conv_before_up.", "up_conv")) for k in sd)
+
+    s = Stoke(
+        model=SwinIR(**kw),
+        optimizer=StokeOptimizer(
+            optimizer="AdamW", optimizer_kwargs={"lr": 1e-3}
+        ),
+        loss=losses.mse_loss,
+        batch_size_per_device=2,
+    )
+    s.init(np.zeros((2, 16, 16, 3), np.float32))
+    s.load_model_state(path, strict=True)
+    for a, b in zip(
+        jax.tree.leaves(s.state.params), jax.tree.leaves(params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-6
+        )
