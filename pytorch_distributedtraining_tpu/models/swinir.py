@@ -24,6 +24,7 @@ TPU-first layout decisions:
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Sequence
 
 import numpy as np
@@ -312,10 +313,13 @@ class SwinIR(nn.Module):
 
     @nn.compact
     def __call__(self, x):  # [B, H, W, C] in [0, img_range]
-        if self.upsampler not in ("pixelshuffledirect", "pixelshuffle"):
+        if self.upsampler not in (
+            "pixelshuffledirect", "pixelshuffle", "nearest+conv"
+        ):
             raise NotImplementedError(
-                "upsampler must be 'pixelshuffledirect' (SwinIR-S) or "
-                "'pixelshuffle' (classical SwinIR-M)"
+                "upsampler must be 'pixelshuffledirect' (SwinIR-S), "
+                "'pixelshuffle' (classical SwinIR-M) or 'nearest+conv' "
+                "(real-SR)"
             )
         mean = jnp.asarray([0.4488, 0.4371, 0.4040], x.dtype) * self.img_range
         b, h, w, c = x.shape
@@ -353,7 +357,42 @@ class SwinIR(nn.Module):
         feat = feat + y
 
         r = self.upscale
-        if self.upsampler == "pixelshuffledirect":
+        if self.upsampler == "nearest+conv":
+            # real-SR tail: nearest 2x resizes interleaved with convs
+            # (official naming: conv_before_upsample.0 / conv_up1 /
+            # conv_up2 / conv_hr / conv_last), scales 2 and 4
+            if r not in (2, 4):
+                raise NotImplementedError(
+                    f"nearest+conv supports scales 2 and 4, got {r}"
+                )
+            nf = 64
+            # official slopes: conv_before_upsample's activation is a
+            # default nn.LeakyReLU (0.01); the shared self.lrelu after
+            # conv_up1/conv_up2/conv_hr is 0.2
+            lrelu = partial(nn.leaky_relu, negative_slope=0.2)
+            nearest2 = lambda a: a.repeat(2, axis=1).repeat(2, axis=2)  # noqa: E731
+            y = nn.leaky_relu(nn.Conv(
+                nf, (3, 3), padding="SAME", dtype=self.dtype,
+                name="conv_before_up",
+            )(feat), negative_slope=0.01)
+            y = lrelu(nn.Conv(
+                nf, (3, 3), padding="SAME", dtype=self.dtype,
+                name="conv_up1",
+            )(nearest2(y)))
+            if r == 4:
+                y = lrelu(nn.Conv(
+                    nf, (3, 3), padding="SAME", dtype=self.dtype,
+                    name="conv_up2",
+                )(nearest2(y)))
+            y = lrelu(nn.Conv(
+                nf, (3, 3), padding="SAME", dtype=self.dtype,
+                name="conv_hr",
+            )(y))
+            out = nn.Conv(
+                self.in_chans, (3, 3), padding="SAME", dtype=self.dtype,
+                name="conv_last",
+            )(y)
+        elif self.upsampler == "pixelshuffledirect":
             # one conv to C*r^2 then depth-to-space (SwinIR-S)
             out = nn.Conv(
                 self.in_chans * r * r, (3, 3), padding="SAME",

@@ -802,7 +802,8 @@ class Stoke:
 
                 key_map = (
                     TORCH_KEY_MAP_CLASSICAL
-                    if self._module.upsampler == "pixelshuffle"
+                    if self._module.upsampler in ("pixelshuffle",
+                                                  "nearest+conv")
                     else TORCH_KEY_MAP
                 )
         if isinstance(source, str):

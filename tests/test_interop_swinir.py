@@ -240,3 +240,42 @@ def test_classical_export_round_trip_and_facade_load(tmp_path):
         np.testing.assert_allclose(
             np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-6
         )
+
+
+def test_realsr_nearest_conv_round_trip(tmp_path):
+    """real-SR family (upsampler='nearest+conv', x4): export emits the
+    official names (conv_before_upsample.0/conv_up1/conv_up2/conv_hr/
+    conv_last), and the facade strict-loads the file back."""
+    from pytorch_distributedtraining_tpu import interop
+
+    kw = dict(depths=[2], embed_dim=12, num_heads=[2], window_size=4,
+              upscale=4, upsampler="nearest+conv")
+    model = SwinIR(**kw)
+    x = jnp.zeros((1, 16, 16, 3))
+    params = model.init(jax.random.key(2), x)["params"]
+    out = model.apply({"params": params}, jnp.ones((1, 16, 16, 3)) * 0.3)
+    assert out.shape == (1, 64, 64, 3)
+
+    path = str(tmp_path / "realsr_x4.pth")
+    interop.save_torch_swinir(path, params)
+    sd = torch.load(path, weights_only=True)["params"]
+    for k in ("conv_before_upsample.0.weight", "conv_up1.weight",
+              "conv_up2.weight", "conv_hr.weight", "conv_last.weight"):
+        assert k in sd, sorted(sd)[:8]
+
+    s = Stoke(
+        model=SwinIR(**kw),
+        optimizer=StokeOptimizer(
+            optimizer="AdamW", optimizer_kwargs={"lr": 1e-3}
+        ),
+        loss=losses.mse_loss,
+        batch_size_per_device=1,
+    )
+    s.init(np.zeros((1, 16, 16, 3), np.float32))
+    s.load_model_state(path, strict=True)
+    for a, b in zip(
+        jax.tree.leaves(s.state.params), jax.tree.leaves(params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-6
+        )
