@@ -6,18 +6,21 @@
 # harvest_results.py at the end. Run detached during a pool outage:
 #     setsid benchmarks/tpu_chain.sh < /dev/null > /dev/null 2>&1 &
 set -u
-OUT="${GRAFT_RESULTS:-/tmp/tpu_results}"
-mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
+OUT="$(readlink -f "${GRAFT_RESULTS:-/tmp/tpu_results}")"
+mkdir -p "$OUT"
 export JAX_COMPILATION_CACHE_DIR=/tmp/graft_jax_compile_cache
-export PYTHONPATH="/root/repo:${PYTHONPATH:-}"
+export PYTHONPATH="$PWD:${PYTHONPATH:-}"
 log() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$OUT/watch.log"; }
 
 log "watcher start"
 while true; do
   if timeout 75 python -c "import jax; d=jax.devices(); print(d[0].platform, len(d))" \
-      > "$OUT/probe.txt" 2>&1; then
-    log "TPU pool is UP: $(cat "$OUT/probe.txt" | tail -1)"
+      > "$OUT/probe.txt" 2>&1 \
+      && grep -qiE "tpu|axon" "$OUT/probe.txt"; then
+    # platform gate: a CPU fallback must NOT end the wait and let the
+    # chain harvest off-chip numbers as "on-chip results"
+    log "TPU pool is UP: $(tail -1 "$OUT/probe.txt")"
     break
   fi
   log "pool still down; sleeping 240s"
@@ -40,7 +43,7 @@ run bench_pallas 300 env GRAFT_BENCH_ATTN=pallas python bench.py
 run bench_packed 300 env GRAFT_BENCH_ATTN=pallas GRAFT_BENCH_ATTN_PACK=2 python bench.py
 run bench_bf16ln 300 env GRAFT_BENCH_NORM=bf16 python bench.py
 run bench_combo  300 env GRAFT_BENCH_ATTN=pallas GRAFT_BENCH_ATTN_PACK=2 GRAFT_BENCH_NORM=bf16 python bench.py
-run bench_trace  300 env GRAFT_BENCH_TRACE=${GRAFT_RESULTS:-/tmp/tpu_results}/xplane python bench.py
+run bench_trace  300 env GRAFT_BENCH_TRACE="$OUT/xplane" python bench.py
 run facade       600 python benchmarks/facade_bench.py
 run attn         600 python benchmarks/attn_bench.py
 run offload      420 python benchmarks/offload_smoke.py
