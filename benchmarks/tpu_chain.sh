@@ -17,7 +17,7 @@ log "watcher start"
 while true; do
   if timeout 75 python -c "import jax; d=jax.devices(); print(d[0].platform, len(d))" \
       > "$OUT/probe.txt" 2>&1 \
-      && grep -qiE "tpu|axon" "$OUT/probe.txt"; then
+      && tail -1 "$OUT/probe.txt" | grep -qiE "^(tpu|axon) "; then
     # platform gate: a CPU fallback must NOT end the wait and let the
     # chain harvest off-chip numbers as "on-chip results"
     log "TPU pool is UP: $(tail -1 "$OUT/probe.txt")"

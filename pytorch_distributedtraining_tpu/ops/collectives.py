@@ -134,11 +134,20 @@ def axis_size(axis_name: str = "dp"):
 def barrier(name: str = "barrier") -> None:
     """Block until every process reaches this point.
 
-    Twin of ``dist.barrier()``; implemented as a tiny global psum through
-    `multihost_utils`, riding the same PJRT coordination the real collectives
-    use. No-op in single-process runs.
+    Twin of ``dist.barrier()`` — a PROCESS barrier, like torch's. Rides
+    the coordination service (pure gRPC) when the distributed client is
+    up, so it is safe even before the first device collective (Gloo's
+    context bootstrap has a fixed ~30 s timeout that pre-collective
+    process skew can blow; see ``runtime.dist.coordination_barrier``).
+    Falls back to a device-collective sync when no client exists (e.g.
+    single-process multi-device test harnesses). No-op single-process.
     """
     if jax.process_count() == 1:
+        return
+    from ..runtime import dist as _dist
+
+    if _dist.has_coordination_client():
+        _dist.coordination_barrier(name)
         return
     from jax.experimental import multihost_utils
 

@@ -137,11 +137,19 @@ def initialize(
     )
 
 
+def has_coordination_client() -> bool:
+    """True when the jax distributed coordination client is initialized."""
+    from jax._src import distributed as _jd
+
+    return _jd.global_state.client is not None
+
+
 def coordination_barrier(name: str = "sync", timeout_s: float = 600.0) -> None:
     """Process-level barrier over the coordination service (pure gRPC).
 
-    Unlike ``ops.barrier`` (a device collective), this never touches the
-    collectives transport — so it is safe BEFORE the first collective.
+    Never touches the collectives transport — safe BEFORE the first
+    device collective (``ops.barrier`` delegates here when a client is
+    up, falling back to a device-collective sync otherwise).
     That matters on oversubscribed hosts: Gloo's context bootstrap has a
     fixed ~30 s KV timeout, and per-rank compile/import skew can exceed it
     (the 4-rank localhost harness on a 1-core box does). Compile first,

@@ -103,6 +103,9 @@ restored = checkpoint_sharded.restore_sharded(ckpt, state.params)
 for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored)):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+# process barrier via the coordination service (ops.barrier multi-proc path)
+from pytorch_distributedtraining_tpu.ops import barrier
+barrier("end_of_child")
 open(os.environ["MARKER"] + os.environ["RANK"], "w").write("ok")
 """
 
