@@ -15,12 +15,13 @@ log() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$OUT/watch.log"; }
 
 log "watcher start"
 while true; do
-  if timeout 75 python -c "import jax; d=jax.devices(); print(d[0].platform, len(d))" \
-      > "$OUT/probe.txt" 2>&1 \
-      && tail -1 "$OUT/probe.txt" | grep -qiE "^(tpu|axon) "; then
-    # platform gate: a CPU fallback must NOT end the wait and let the
-    # chain harvest off-chip numbers as "on-chip results"
-    log "TPU pool is UP: $(tail -1 "$OUT/probe.txt")"
+  # stderr goes to its own file so library log lines can neither satisfy
+  # nor spoil the sentinel match; a CPU fallback must NOT end the wait
+  # and let the chain harvest off-chip numbers as "on-chip results"
+  if timeout 75 python -c "import jax; d=jax.devices(); print('PLATFORM='+d[0].platform, len(d))" \
+      > "$OUT/probe.txt" 2> "$OUT/probe.err" \
+      && grep -qiE "^PLATFORM=(tpu|axon)" "$OUT/probe.txt"; then
+    log "TPU pool is UP: $(grep -iE '^PLATFORM=' "$OUT/probe.txt" | tail -1)"
     break
   fi
   log "pool still down; sleeping 240s"

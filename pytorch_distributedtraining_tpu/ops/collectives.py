@@ -131,7 +131,7 @@ def axis_size(axis_name: str = "dp"):
 # -- host-level (outside jit) ------------------------------------------------
 
 
-def barrier(name: str = "barrier") -> None:
+def barrier(name: str = "barrier", timeout_s: float = 1800.0) -> None:
     """Block until every process reaches this point.
 
     Twin of ``dist.barrier()`` — a PROCESS barrier, like torch's. Rides
@@ -147,7 +147,9 @@ def barrier(name: str = "barrier") -> None:
     from ..runtime import dist as _dist
 
     if _dist.has_coordination_client():
-        _dist.coordination_barrier(name)
+        # default matches torch dist.barrier's 30-min patience (a rank can
+        # legitimately spend minutes in a cold compile before arriving)
+        _dist.coordination_barrier(name, timeout_s=timeout_s)
         return
     from jax.experimental import multihost_utils
 
