@@ -83,6 +83,18 @@ class GPT2Config:
         return GPT2Config()  # the 125M point IS the default config
 
     @staticmethod
+    def gpt2_medium() -> "GPT2Config":  # 350M
+        return GPT2Config(n_embd=1024, n_layer=24, n_head=16)
+
+    @staticmethod
+    def gpt2_large() -> "GPT2Config":  # 774M
+        return GPT2Config(n_embd=1280, n_layer=36, n_head=20)
+
+    @staticmethod
+    def gpt2_xl() -> "GPT2Config":  # 1.5B
+        return GPT2Config(n_embd=1600, n_layer=48, n_head=25)
+
+    @staticmethod
     def tiny(**kw) -> "GPT2Config":
         base = dict(vocab_size=256, n_positions=64, n_embd=32, n_layer=2,
                     n_head=2, dtype=jnp.float32)

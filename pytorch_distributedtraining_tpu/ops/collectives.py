@@ -140,7 +140,9 @@ def barrier(name: str = "barrier", timeout_s: float = 1800.0) -> None:
     context bootstrap has a fixed ~30 s timeout that pre-collective
     process skew can blow; see ``runtime.dist.coordination_barrier``).
     Falls back to a device-collective sync when no client exists (e.g.
-    single-process multi-device test harnesses). No-op single-process.
+    single-process multi-device test harnesses) — note that fallback has
+    no timeout mechanism, so ``timeout_s`` only bounds the
+    coordination-service path. No-op single-process.
     """
     if jax.process_count() == 1:
         return

@@ -135,3 +135,28 @@ class TestViT:
         updates, _ = tx.update(grads, opt_state, params)
         l1 = jax.jit(loss_fn)(optax.apply_updates(params, updates))
         assert float(l1) < float(l0)
+
+
+def test_gpt2_size_ladder_param_counts():
+    """The published GPT-2 family sizes, via eval_shape (no weights)."""
+    import numpy as np
+
+    from pytorch_distributedtraining_tpu.models.gpt2 import GPT2, GPT2Config
+
+    for cfg, lo, hi in [
+        (GPT2Config.gpt2_125m(), 115e6, 135e6),
+        (GPT2Config.gpt2_medium(), 330e6, 380e6),
+        (GPT2Config.gpt2_large(), 750e6, 830e6),
+        (GPT2Config.gpt2_xl(), 1.5e9, 1.65e9),
+    ]:
+        assert cfg.n_embd % cfg.n_head == 0
+        shapes = jax.eval_shape(
+            lambda r, cfg=cfg: GPT2(cfg).init(
+                r, jnp.zeros((1, 8), jnp.int32)
+            ),
+            jax.random.PRNGKey(0),
+        )
+        n = sum(
+            int(np.prod(s.shape)) for s in jax.tree.leaves(shapes["params"])
+        )
+        assert lo < n < hi, (cfg, n)
