@@ -223,9 +223,11 @@ def _extract_json_line(lines: list[str]) -> str | None:
 
 def main() -> None:
     if os.environ.get("_GRAFT_BENCH_CHILD") == "1":
+        _unblock_inherited_mask()
         _bench()
         return
     if os.environ.get("_GRAFT_BENCH_PROBE") == "1":
+        _unblock_inherited_mask()
         _probe()
         return
 
@@ -307,6 +309,16 @@ def main() -> None:
     _emit_error(f"TPU bench failed: {err}")
 
 
+def _unblock_inherited_mask() -> None:
+    """Children inherit the parent's spawn-window signal mask (blocked
+    SIGTERM/SIGALRM); clear it so an orphaned child — parent SIGKILLed
+    before its handlers could run — still dies to a plain kill instead of
+    holding the TPU claim until SIGKILL."""
+    signal.pthread_sigmask(
+        signal.SIG_UNBLOCK, {signal.SIGTERM, signal.SIGALRM}
+    )
+
+
 def _force_platform() -> None:
     """Honor GRAFT_BENCH_PLATFORM via the config API.
 
@@ -323,12 +335,23 @@ def _force_platform() -> None:
 
 
 def _probe() -> None:
-    """Child: init the backend and list devices, nothing else."""
+    """Child: init the backend and list devices, nothing else.
+
+    Gates on the platform actually being a TPU (unless a platform was
+    explicitly requested for envelope self-tests): a silent CPU fallback
+    must fail the probe, not publish a CPU number as the per-chip metric.
+    """
     _force_platform()
     import jax
 
     devs = jax.devices()
     print(f"platform={devs[0].platform} n={len(devs)} {devs[0].device_kind}")
+    if (
+        not os.environ.get("GRAFT_BENCH_PLATFORM")
+        and devs[0].platform not in ("tpu", "axon")
+    ):
+        print(f"# probe: refusing non-TPU platform {devs[0].platform}")
+        sys.exit(3)
 
 
 def _bench() -> None:

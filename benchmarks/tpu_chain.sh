@@ -40,11 +40,11 @@ run() { # name, timeout, cmd...
 # knob-candidate A/B bench reruns (cheap, warm cache), then the rest
 run bench        420 python bench.py
 run profile      900 python benchmarks/profile_swinir.py
-run bench_pallas 300 env GRAFT_BENCH_ATTN=pallas python bench.py
-run bench_packed 300 env GRAFT_BENCH_ATTN=pallas GRAFT_BENCH_ATTN_PACK=2 python bench.py
-run bench_bf16ln 300 env GRAFT_BENCH_NORM=bf16 python bench.py
-run bench_combo  300 env GRAFT_BENCH_ATTN=pallas GRAFT_BENCH_ATTN_PACK=2 GRAFT_BENCH_NORM=bf16 python bench.py
-run bench_trace  300 env GRAFT_BENCH_TRACE="$OUT/xplane" python bench.py
+run bench_pallas 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=pallas python bench.py
+run bench_packed 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=pallas GRAFT_BENCH_ATTN_PACK=2 python bench.py
+run bench_bf16ln 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_NORM=bf16 python bench.py
+run bench_combo  360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=pallas GRAFT_BENCH_ATTN_PACK=2 GRAFT_BENCH_NORM=bf16 python bench.py
+run bench_trace  360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_TRACE="$OUT/xplane" python bench.py
 run facade       600 python benchmarks/facade_bench.py
 run attn         600 python benchmarks/attn_bench.py
 run offload      420 python benchmarks/offload_smoke.py

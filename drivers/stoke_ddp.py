@@ -275,7 +275,7 @@ def main(argv=None):
     # above the base either (torch's min_lr floors, it never raises).
     scheduler2 = ReduceLROnPlateau(
         mode="min", factor=0.2, patience=2, verbose=True,
-        min_factor=min(1.0, 5e-5 / opt.lr),
+        min_factor=min(1.0, 5e-5 / max(opt.lr, 1e-12)),
     )
 
     config = dict(

@@ -190,9 +190,11 @@ class DataLoader:
             return  # ordering is epoch-independent; no desync possible
         if self.sampler is not None and not getattr(self.sampler, "shuffle", True):
             return  # unshuffled sampler ignores the epoch entirely
-        import jax
+        from ..runtime.dist import process_count_if_initialized
 
-        if jax.process_count() <= 1:
+        # no jax.process_count() here: that would init a backend (and on
+        # this image possibly hang on a TPU claim) from a warning check
+        if process_count_if_initialized() <= 1:
             return
         self._warned_desync = True
         import warnings

@@ -137,6 +137,24 @@ def initialize(
     )
 
 
+def process_count_if_initialized() -> int:
+    """Process count WITHOUT initializing a backend.
+
+    ``jax.process_count()`` touches ``get_backend()`` — on this image that
+    can mean a TPU claim attempt (which hangs during pool outages) as a
+    side effect. Host-side code that only needs "am I multi-process?"
+    (e.g. the DataLoader's desync warning) should use this instead: it
+    reads the coordination client's metadata and returns 1 when no client
+    is up.
+    """
+    from jax._src import distributed as _jd
+
+    state = _jd.global_state
+    if state.client is None:
+        return 1
+    return int(state.num_processes or 1)
+
+
 def has_coordination_client() -> bool:
     """True when the jax distributed coordination client is initialized."""
     from jax._src import distributed as _jd

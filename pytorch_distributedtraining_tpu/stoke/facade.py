@@ -934,6 +934,8 @@ class Stoke:
             loss = jnp.asarray(loss)
         except (TypeError, ValueError):
             return
+        if loss.ndim != 0:  # per-sample/per-shard losses: monitor the mean
+            loss = jnp.mean(loss)
         self._last_loss_dev = loss
         self._ema_dev = (
             jnp.asarray(loss, jnp.float32)

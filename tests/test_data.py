@@ -211,10 +211,12 @@ def test_loader_auto_epoch_desync_warns_multiprocess(monkeypatch):
 
     import jax
 
+    from pytorch_distributedtraining_tpu.runtime import dist as rdist
+
     ds = TensorDataset(np.arange(8))
     s = DistributedSampler(ds, num_replicas=2, rank=0, shuffle=True, seed=0)
     dl = DataLoader(ds, batch_size=4, sampler=s)
-    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(rdist, "process_count_if_initialized", lambda: 2)
     with warnings.catch_warnings():
         warnings.simplefilter("error")  # 1st iter: no warning
         next(iter(dl))
@@ -236,10 +238,12 @@ def test_loader_auto_epoch_no_warning_with_explicit_set_epoch(monkeypatch):
 
     import jax
 
+    from pytorch_distributedtraining_tpu.runtime import dist as rdist
+
     ds = TensorDataset(np.arange(8))
     s = DistributedSampler(ds, num_replicas=2, rank=0, shuffle=True, seed=0)
     dl = DataLoader(ds, batch_size=4, sampler=s)
-    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(rdist, "process_count_if_initialized", lambda: 2)
     with warnings.catch_warnings():
         warnings.simplefilter("error")
         for epoch in range(3):
