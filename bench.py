@@ -51,8 +51,14 @@ ATTEMPTS = int(os.environ.get("GRAFT_BENCH_ATTEMPTS", "2"))
 # 0 = no per-attempt cap (each attempt may use the whole remaining clock)
 ATTEMPT_TIMEOUT_S = int(os.environ.get("GRAFT_BENCH_TIMEOUT", "0"))
 RETRY_BACKOFF_S = int(os.environ.get("GRAFT_BENCH_BACKOFF", "5"))
+# Machine-keyed cache dir (VERDICT r3 weak #5): AOT code compiled on a
+# different host CPU must miss, not SIGILL. _hostfp is stdlib-only, so the
+# budget-bounded parent stays jax-free.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from pytorch_distributedtraining_tpu._hostfp import salted_cache_dir  # noqa: E402
+
 COMPILE_CACHE_DIR = os.environ.get(
-    "GRAFT_BENCH_CACHE", "/tmp/graft_jax_compile_cache"
+    "GRAFT_BENCH_CACHE", salted_cache_dir("/tmp/graft_jax_compile_cache")
 )
 
 _DEADLINE = time.monotonic() + TOTAL_BUDGET_S
@@ -359,6 +365,20 @@ def _bench() -> None:
     import numpy as np
     import jax
     import jax.numpy as jnp
+
+    # Replicate the probe's platform gate: if the pool drops between the
+    # probe and this attempt, jax silently falls back to CPU and the tiny
+    # CPU throughput would be published as the official per-chip metric
+    # with rc=0. Distinct rc=4 so the parent's error record names it.
+    if (
+        not os.environ.get("GRAFT_BENCH_PLATFORM")
+        and jax.devices()[0].platform not in ("tpu", "axon")
+    ):
+        print(
+            f"bench child refusing non-TPU platform "
+            f"{jax.devices()[0].platform} (pool dropped after probe?)"
+        )
+        sys.exit(4)
 
     print("# child: backend up, building model", flush=True)
 

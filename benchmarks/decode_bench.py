@@ -38,9 +38,11 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    jax.config.update(
-        "jax_compilation_cache_dir", f"/tmp/jax_bench_cache_{os.getuid()}"
-    )
+    # honor an externally provided cache (tpu_chain.sh shares one warm
+    # cache across stages); the machine-keyed fallback otherwise
+    from pytorch_distributedtraining_tpu.runtime.cache import cache_dir
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir("bench"))
 
     from pytorch_distributedtraining_tpu.models.gpt2 import GPT2, GPT2Config
     from pytorch_distributedtraining_tpu.models.generate import generate

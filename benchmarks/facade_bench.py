@@ -121,7 +121,11 @@ def main() -> None:
         # same single-device mesh as path A: the ratio must compare equal
         # hardware (Stoke would otherwise span every local device)
         mesh=make_mesh(MeshSpec(dp=1), devices=jax.devices()[:1]),
-        verbose=True,
+        # quiet for the headline ratio: verbose=True makes print_ema_loss
+        # device_get the EMA every step — a per-step host sync that would
+        # attribute scaffolding cost to the facade. A separate verbose
+        # timing below reports that sync cost on its own line.
+        verbose=False,
         optimizer=StokeOptimizer(
             optimizer="AdamW",
             optimizer_kwargs={"lr": 5e-4, "betas": (0.9, 0.99), "eps": 1e-8,
@@ -159,11 +163,27 @@ def main() -> None:
     facade_dt = time.perf_counter() - t0
     facade_ips = BATCH * STEPS / facade_dt
 
+    # verbose re-run: same compiled functions, but print_ema_loss now
+    # device_gets the EMA each step (the reference's per-step print,
+    # Stoke-DDP.py:76). Reported separately so the sync cost is attributed
+    # to verbosity, not to facade bookkeeping.
+    stoke_model.verbose = True
+    synced = facade_iter()  # re-warm the print path
+    jax.block_until_ready(synced)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        synced = facade_iter()
+    jax.block_until_ready(synced)
+    verbose_dt = time.perf_counter() - t0
+    stoke_model.verbose = False
+    verbose_ips = BATCH * STEPS / verbose_dt
+
     ratio = facade_ips / raw_ips
     for metric, value, unit in (
         ("trainstep_images_per_sec", raw_ips, "images/sec/chip"),
         ("facade_loop_images_per_sec", facade_ips, "images/sec/chip"),
         ("facade_vs_trainstep_ratio", ratio, "ratio"),
+        ("facade_verbose_vs_trainstep_ratio", verbose_ips / raw_ips, "ratio"),
     ):
         print(json.dumps({
             "metric": metric,

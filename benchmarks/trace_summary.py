@@ -79,12 +79,13 @@ def summarize(events, top: int):
     # pid ("XLA Modules" = whole-step envelopes, "Steps", "XLA Ops" = the
     # individual ops). Counting the envelope lanes would double the total
     # and halve every op's share — keep only op lanes when they exist.
-    # exact-lane match: a bare "op" substring would also catch envelope
-    # lanes like "TensorFlow Name Scope" and re-introduce double counting
+    # exact-lane match against the known TensorBoard op-lane names: a
+    # suffix heuristic (rstrip('s').endswith('op')) would also count lanes
+    # like "Stop"/"Loops" as op lanes on unusual trace layouts
     op_tids = {
         key for key, name in threads.items()
         if key[0] in use_pids
-        and (name or "").lower().rstrip("s").endswith("op")
+        and (name or "").strip().lower() in ("xla ops", "tensorflow ops")
     }
 
     def _lane_ok(e):

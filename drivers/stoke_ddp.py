@@ -24,6 +24,8 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from pytorch_distributedtraining_tpu import metrics
@@ -100,6 +102,17 @@ def train(train_dataloader, stoke_model: Stoke, scheduler1, scheduler2, epoch: i
         if ((batch_ct + 1) % 50) == 0:
             train_log(stoke_model.detach_and_sync_loss(train_loss), example_ct, epoch)
 
+    if batch_ct == 0:
+        # a silent zero-batch epoch leaves the model uninitialized and
+        # surfaces later as a confusing validate() failure — name the
+        # actual cause (global batch = per-device x n_devices > split size)
+        raise ValueError(
+            "train dataloader yielded no batches: the dataset split is "
+            "smaller than one global batch "
+            f"(len(dataset)={len(getattr(train_dataloader, 'dataset', []))}, "
+            f"global batch={getattr(train_dataloader, 'batch_size', '?')}); "
+            "lower --batchSize or provide more data"
+        )
     avg_loss = sum_loss / max(1, len(train_dataloader))
     return float(avg_loss)  # one host sync per epoch, at the boundary
 
@@ -107,22 +120,24 @@ def train(train_dataloader, stoke_model: Stoke, scheduler1, scheduler2, epoch: i
 def validate(val_dataloader, stoke_model: Stoke, epoch):
     stoke_model.model_access.eval()
 
-    val_loss, example_ct = 0.0, 0
-    mae, psnr = 0.0, 0.0
-    batches = 0
+    # one compiled fwd+metrics program per batch under the training layout
+    # (facade EvalStep); totals accumulate as device scalars, so the whole
+    # epoch costs ONE host sync at the bottom — the reference's loop
+    # (`Stoke-DDP.py:114-121`) host-synced 3x per batch
+    eval_step = stoke_model.eval_step({"mae": metrics.mae, "psnr": metrics.psnr})
 
+    totals, example_ct, batches = None, 0, 0
     for inputs, targets in val_dataloader:
         example_ct += len(inputs)
-        outputs = stoke_model.model(inputs)
-        val_loss += float(stoke_model.loss(outputs, targets))
-        mae += float(metrics.mae(outputs, targets))
-        psnr += float(metrics.psnr(outputs, targets))
+        m = eval_step(inputs, targets)
+        totals = m if totals is None else jax.tree.map(jnp.add, totals, m)
         batches += 1
 
     n = max(1, batches)
-    val_avg_loss = val_loss / n
-    avg_mae = mae / n
-    avg_psnr = psnr / n
+    host = {} if totals is None else jax.device_get(totals)  # the one sync
+    val_avg_loss = float(host.get("loss", 0.0)) / n
+    avg_mae = float(host.get("mae", 0.0)) / n
+    avg_psnr = float(host.get("psnr", 0.0)) / n
 
     val_log(val_avg_loss, avg_mae, avg_psnr, example_ct, epoch)
     stoke_model.print_on_devices(

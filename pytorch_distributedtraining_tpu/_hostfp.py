@@ -1,0 +1,56 @@
+"""Host-CPU fingerprint — stdlib-only, import-light.
+
+Lives at the package top level (outside ``runtime/``, whose ``__init__``
+imports jax) so budget-bounded entry points — bench.py's orchestrating
+parent, benchmarks/tpu_chain.sh's watcher — can key their compile-cache
+dirs without paying a jax import. ``runtime.cache`` re-exports it for
+in-framework callers.
+
+Why fingerprint at all: XLA:CPU AOT artifacts are specialized to the
+compiling host's CPU features; reusing a cache dir across machines (shared
+/tmp images, copied containers) risks SIGILL on the consumer. Keying every
+persistent cache dir by this hash makes a foreign machine miss cleanly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import platform
+
+
+def machine_fingerprint() -> str:
+    """Short stable hash of the host's CPU feature set.
+
+    Reads the first processor's ``flags`` line from ``/proc/cpuinfo`` (the
+    feature list XLA:CPU specializes against) plus the machine arch; falls
+    back to ``platform`` identifiers where /proc is unavailable.
+    """
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):  # x86 / arm
+                    flags = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        flags = platform.processor()
+    key = f"{platform.machine()}|{flags}"
+    return hashlib.sha256(key.encode()).hexdigest()[:12]
+
+
+def salted_cache_dir(prefix: str) -> str:
+    """``{prefix}_{uid}_{fingerprint}`` — the one definition of the salted
+    cache path, shared by bench.py (Python) and tpu_chain.sh (via the CLI
+    below) so standalone and chain runs hit the same warm cache."""
+    import os
+
+    return f"{prefix}_{os.getuid()}_{machine_fingerprint()}"
+
+
+if __name__ == "__main__":
+    import sys
+
+    if len(sys.argv) > 2 and sys.argv[1] == "--cache-dir":
+        print(salted_cache_dir(sys.argv[2]))
+    else:
+        print(machine_fingerprint())

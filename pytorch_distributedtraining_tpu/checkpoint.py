@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any
+from typing import Any, NamedTuple
 
 import numpy as np
 import jax
@@ -124,12 +124,31 @@ def restore_positional(flat: dict, root: str, template):
     return jax.tree.unflatten(treedef, [v for _, v in sub])
 
 
+class IncompatibleKeys(NamedTuple):
+    """Torch ``load_state_dict`` return twin: which keys didn't line up."""
+
+    missing_keys: list
+    unexpected_keys: list
+
+
 def load_params_dict(
-    source: dict, template: dict, strict: bool = True, param_key: str = "params"
+    source: dict,
+    template: dict,
+    strict: bool = True,
+    param_key: str = "params",
+    warn: bool = True,
+    return_keys: bool = False,
 ):
     """Torch ``load_state_dict`` parity (`Stoke-DDP.py:209-213`): accept a
     dict optionally nested under ``param_key``; with ``strict`` raise on
-    missing/unexpected keys; shapes must match."""
+    missing/unexpected keys; shapes must match.
+
+    Non-strict loads report skipped keys via a RuntimeWarning by default;
+    intentional partial loads (e.g. dropping head keys) pass ``warn=False``
+    or ``return_keys=True`` — the latter returns ``(tree,
+    IncompatibleKeys)`` like torch's silent return and suppresses the
+    warning, letting the caller decide.
+    """
     src = source[param_key] if param_key in source else source
     flat_src = tree_to_flat_dict(src) if not _is_flat(src) else src
     flat_tpl = tree_to_flat_dict(template)
@@ -142,15 +161,14 @@ def load_params_dict(
         )
         if strict:
             raise ValueError(f"strict load failed — {detail}")
-        # torch returns IncompatibleKeys; surfacing the same information
-        # as a warning keeps the non-strict path honest instead of silent
-        import warnings
+        if warn and not return_keys:
+            import warnings
 
-        warnings.warn(
-            f"non-strict load skipped keys — {detail}",
-            RuntimeWarning,
-            stacklevel=2,
-        )
+            warnings.warn(
+                f"non-strict load skipped keys — {detail}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
     out = dict(flat_tpl)
     for k in flat_tpl:
         if k in flat_src:
@@ -160,7 +178,10 @@ def load_params_dict(
                     f"{np.shape(flat_src[k])} vs model {np.shape(flat_tpl[k])}"
                 )
             out[k] = flat_src[k]
-    return flat_dict_to_tree(out)
+    tree = flat_dict_to_tree(out)
+    if return_keys:
+        return tree, IncompatibleKeys(missing, unexpected)
+    return tree
 
 
 def _is_flat(d: dict) -> bool:

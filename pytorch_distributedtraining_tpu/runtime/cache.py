@@ -1,0 +1,34 @@
+"""Machine-keyed persistent compile-cache directories.
+
+XLA:CPU AOT artifacts are specialized to the compiling host's CPU
+features; reusing a cache dir across machines (shared /tmp images, copied
+containers) risks SIGILL on the consumer ("machine features don't match"
+warnings in MULTICHIP_r03.json's tail). Every persistent cache dir in the
+repo (tests, dryrun, bench) is therefore keyed by a fingerprint of the
+host CPU so a foreign machine gets a fresh, compatible cache instead of
+foreign AOT code.
+
+The fingerprint itself lives in the stdlib-only ``.._hostfp`` so jax-free
+entry points (bench.py's parent, tpu_chain.sh) can use it too.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .._hostfp import machine_fingerprint
+
+__all__ = ["cache_dir", "machine_fingerprint"]
+
+
+def cache_dir(label: str) -> str:
+    """Per-user, per-machine compile-cache path for ``label``.
+
+    ``/tmp/jax_{label}_cache_{uid}_{fingerprint}``; honors an explicit
+    ``JAX_COMPILATION_CACHE_DIR`` by returning it unchanged so callers can
+    share one externally managed cache (e.g. tpu_chain.sh).
+    """
+    env = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if env:
+        return env
+    return f"/tmp/jax_{label}_cache_{os.getuid()}_{machine_fingerprint()}"

@@ -9,7 +9,10 @@ import numpy as np
 import pytest
 
 from pytorch_distributedtraining_tpu import optim
-from pytorch_distributedtraining_tpu.checkpoint import load_params_dict
+from pytorch_distributedtraining_tpu.checkpoint import (
+    load_params_dict,
+    tree_to_flat_dict,
+)
 from pytorch_distributedtraining_tpu.checkpoint_sharded import (
     CheckpointManager,
     restore_sharded,
@@ -214,6 +217,36 @@ class TestTorchInterop:
             )
         # matched keys loaded, template structure intact
         assert set(params) == set(template)
+
+    def test_non_strict_return_keys_is_silent(self, tmp_path):
+        """ADVICE r3: intentional partial loads opt out of the warning —
+        return_keys gives torch's IncompatibleKeys and stays quiet."""
+        import warnings
+
+        from pytorch_distributedtraining_tpu.checkpoint import IncompatibleKeys
+
+        model = Net(upscale_factor=2)
+        template = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3))
+        )["params"]
+        src = dict(jax.tree.map(np.asarray, tree_to_flat_dict(template)))
+        dropped = sorted(src)[0]
+        src.pop(dropped)
+        src["rogue"] = np.zeros(3, np.float32)
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # any warning fails the test
+            params, keys = load_params_dict(
+                {"params": src}, template, strict=False, return_keys=True
+            )
+        assert isinstance(keys, IncompatibleKeys)
+        assert keys.missing_keys == [dropped]
+        assert keys.unexpected_keys == ["rogue"]
+        assert set(tree_to_flat_dict(params)) == set(tree_to_flat_dict(template))
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            load_params_dict({"params": src}, template, strict=False, warn=False)
 
     def test_torch_layout_conversion(self):
         from pytorch_distributedtraining_tpu.interop import (
