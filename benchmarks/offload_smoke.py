@@ -35,11 +35,13 @@ from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
 BATCH, PATCH, STEPS, WARMUP = 18, 64, 10, 2
 
 
-def run(offload: bool):
+def run(offload: bool, offload_params: bool = False):
     mesh = make_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
     model = SwinIR(dtype=jnp.bfloat16)
     tx = optim.adamw(lr=5e-4)
-    policy = ZeRO1(offload_opt_state=offload)
+    policy = ZeRO1(
+        offload_opt_state=offload, offload_params=offload_params
+    )
 
     def loss_fn(params, batch, rng, model_state):
         lr_img, hr_img = batch
@@ -54,6 +56,10 @@ def run(offload: bool):
     )
     kinds = {
         x.sharding.memory_kind for x in jax.tree.leaves(state.opt_state)
+        if hasattr(x, "sharding")
+    }
+    par_kinds = {
+        x.sharding.memory_kind for x in jax.tree.leaves(state.params)
         if hasattr(x, "sharding")
     }
     step = TrainStep(
@@ -73,9 +79,15 @@ def run(offload: bool):
             state, m = step(state, batch)
         jax.block_until_ready(m["loss"])
         dt = (time.perf_counter() - t0) / STEPS
+    arm = "hbm"
+    if offload:
+        arm = "offload_opt+param" if offload_params else "offload_opt"
+    elif offload_params:
+        arm = "offload_param"
     print(json.dumps({
-        "arm": "offload" if offload else "hbm",
+        "arm": arm,
         "opt_state_memory_kinds": sorted(k for k in kinds if k),
+        "param_memory_kinds": sorted(k for k in par_kinds if k),
         "ms_per_step": round(dt * 1e3, 2),
         "loss": float(m["loss"]),
     }), flush=True)
@@ -89,6 +101,7 @@ def main():
     }), flush=True)
     run(offload=False)
     run(offload=True)
+    run(offload=False, offload_params=True)  # DeepspeedOffloadParamConfig twin
 
 
 if __name__ == "__main__":

@@ -269,12 +269,18 @@ def main(argv=None):
         sampler=train_sampler,
         num_workers=opt.threads,
         multiprocessing_context="spawn",
+        # one spawn per run, not per epoch: worker startup is ~1 s each
+        persistent_workers=True,
     )
     val_dataloader = stoke_model.DataLoader(
         dataset=val_dataset,
         sampler=val_sampler,
         multiprocessing_context="spawn",
-        num_workers=8,
+        # reference hardcodes 8 (`Stoke-DDP.py:297`); capped by --threads so
+        # an explicit --threads 0 (no workers) applies to validation too —
+        # spawn is a real process pool here, not a no-op
+        num_workers=min(8, opt.threads),
+        persistent_workers=True,
         drop_last=False,  # a small val split must not become zero batches
     )
 
@@ -318,6 +324,8 @@ def main(argv=None):
         print("--------Val Loss after Epoch {} - {} --------".format(epoch, val_loss))
 
     wandb.finish()
+    train_dataloader.shutdown_workers()
+    val_dataloader.shutdown_workers()
     return train_loss, val_loss
 
 

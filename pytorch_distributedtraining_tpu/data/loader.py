@@ -17,13 +17,29 @@ Twin of torch's multi-worker ``DataLoader`` as the reference drives it
 
 from __future__ import annotations
 
+import multiprocessing
 import queue
 import threading
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 
 import numpy as np
 
 from .sampler import DistributedSampler
+
+# Process-worker state: the dataset is shipped ONCE per worker via the
+# executor initializer (torch ships it once per worker the same way,
+# `torch/utils/data/_utils/worker.py`), then looked up per fetch. Module
+# level because spawn pickles by reference to importable names.
+_WORKER_DATASET = None
+
+
+def _process_worker_init(dataset):
+    global _WORKER_DATASET
+    _WORKER_DATASET = dataset
+
+
+def _process_worker_fetch(i):
+    return _WORKER_DATASET[i]
 
 
 def default_collate(samples):
@@ -52,9 +68,21 @@ def default_collate(samples):
 class DataLoader:
     """Iterates `(batch, ...)` pytrees of numpy (or sharded jax) arrays.
 
-    Args mirror the torch surface the reference uses; ``pin_memory`` and
-    ``persistent_workers`` are accepted for parity and ignored (the TPU
-    runtime has no pageable/pinned distinction on this path).
+    Args mirror the torch surface the reference uses; ``pin_memory`` is
+    accepted for parity and ignored (the TPU runtime has no
+    pageable/pinned distinction on this path).
+
+    Workers default to **threads** (PIL decode releases the GIL; no
+    spawn/pickle tax). ``multiprocessing_context="spawn"|"fork"|
+    "forkserver"`` switches to real worker **processes** — the escape
+    hatch for GIL-bound user transforms (numpy-heavy augmentation in
+    Python loops), honoring the reference's spawn surface
+    (`Stoke-DDP.py:290,296`). The dataset must be picklable; it ships to
+    each worker once. ``persistent_workers=True`` keeps the process pool
+    alive across epochs (spawn startup is ~1 s/worker, once per
+    ``__iter__`` otherwise). As with torch's spawn context, the entry
+    script must be import-safe (``if __name__ == "__main__"`` guard) —
+    spawn workers re-import it.
 
     If ``mesh`` and ``spec`` are given, each batch is returned as a global
     jax.Array laid out by ``NamedSharding(mesh, spec)`` — this process's
@@ -75,14 +103,27 @@ class DataLoader:
         mesh=None,
         spec=None,
         pin_memory: bool = False,  # parity no-op
-        persistent_workers: bool = False,  # parity no-op
-        multiprocessing_context=None,  # parity no-op (threads here)
+        persistent_workers: bool = False,
+        multiprocessing_context=None,  # None/"thread" -> threads
         auto_set_epoch: bool = True,
     ):
         if sampler is not None and shuffle:
             raise ValueError("provide either sampler or shuffle, not both")
         if (mesh is None) != (spec is None):
             raise ValueError("mesh and spec must be given together")
+        ctx = multiprocessing_context
+        if ctx is not None and not isinstance(ctx, str):
+            # torch also accepts a context object; keep its start method
+            ctx = getattr(ctx, "get_start_method", lambda: None)() or str(ctx)
+        if ctx not in (None, "thread", "spawn", "fork", "forkserver"):
+            raise ValueError(
+                f"multiprocessing_context={multiprocessing_context!r}: "
+                "expected None/'thread' (worker threads) or "
+                "'spawn'/'fork'/'forkserver' (worker processes)"
+            )
+        self._mp_context = None if ctx == "thread" else ctx
+        self.persistent_workers = bool(persistent_workers)
+        self._pool = None  # live persistent executor, if any
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -210,21 +251,57 @@ class DataLoader:
             stacklevel=3,
         )
 
+    def _get_pool(self):
+        """Executor + fetch fn: threads by default, processes when a
+        multiprocessing context was requested (the GIL escape hatch)."""
+        if self._mp_context is None:
+            return (
+                ThreadPoolExecutor(max_workers=self.num_workers),
+                lambda i: self.dataset[i],
+                False,
+            )
+        if self._pool is not None:
+            if getattr(self._pool, "_broken", False):
+                # a worker died (OOM-kill, segfault): a broken executor
+                # fails every submit forever — replace it, don't cache it
+                self._pool.shutdown(wait=False, cancel_futures=True)
+                self._pool = None
+            else:
+                return self._pool, _process_worker_fetch, True
+        pool = ProcessPoolExecutor(
+            max_workers=self.num_workers,
+            mp_context=multiprocessing.get_context(self._mp_context),
+            initializer=_process_worker_init,
+            initargs=(self.dataset,),
+        )
+        if self.persistent_workers:
+            self._pool = pool
+        return pool, _process_worker_fetch, self.persistent_workers
+
+    def shutdown_workers(self):
+        """Tear down a persistent process pool (idempotent)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.shutdown_workers()
+        except Exception:
+            pass
+
     def _make_iter(self, batches):
         if self.num_workers <= 0:
             for idxs in batches:
                 yield self._to_device(self.collate_fn([self.dataset[i] for i in idxs]))
             return
 
-        # threaded fetch: pool loads samples, a feeder thread keeps
+        # pooled fetch: workers load samples, a feeder thread keeps
         # `prefetch` collated batches in flight ahead of the consumer
-        pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        pool, fetch, keep_pool = self._get_pool()
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
         _END, _ERR = object(), object()
-
-        def fetch(i):
-            return self.dataset[i]
 
         def put(item) -> bool:
             # bounded put that aborts when the consumer abandoned the
@@ -271,4 +348,5 @@ class DataLoader:
                 yield self._to_device(item)
         finally:
             stop.set()
-            pool.shutdown(wait=False, cancel_futures=True)
+            if not keep_pool:
+                pool.shutdown(wait=False, cancel_futures=True)

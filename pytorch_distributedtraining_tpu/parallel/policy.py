@@ -39,6 +39,10 @@ class Policy:
     # the update. Falls back to HBM on backends without host-placement
     # support (see spec.host_offload_supported).
     offload_opt_state: bool = False
+    # DeepSpeed offload_param twin: params resident in pinned host memory,
+    # streamed to the chip per step (fwd/bwd read them, the update writes
+    # back host-side). Same fallback rule as offload_opt_state.
+    offload_params: bool = False
 
     # -- spec builders (trees of PartitionSpec) ----------------------------
 

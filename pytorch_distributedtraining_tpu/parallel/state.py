@@ -86,18 +86,28 @@ def create_train_state(
         scaler=jax.tree.map(lambda _: P(), shapes.scaler),
     )
     shardings = tree_shardings(specs, mesh)
-    if policy.offload_opt_state:
+
+    def offload(field: str, what: str):
+        """Place one TrainState field in pinned host memory, or fall back
+        to device memory with a warning on backends without host
+        placement (one rule for every offload knob)."""
+        nonlocal shardings
         if host_offload_supported(mesh):
-            shardings = shardings.replace(
-                opt_state=tree_shardings(
-                    specs.opt_state, mesh, memory_kind="pinned_host"
+            shardings = shardings.replace(**{
+                field: tree_shardings(
+                    getattr(specs, field), mesh, memory_kind="pinned_host"
                 )
-            )
+            })
         else:
             logger.warning(
-                "optimizer-state host offload requested but the %s backend "
-                "has no host-placement support; keeping opt state in device "
-                "memory", mesh.devices.flat[0].platform,
+                "%s host offload requested but the %s backend has no "
+                "host-placement support; keeping %s in device memory",
+                what, mesh.devices.flat[0].platform, what,
             )
+
+    if policy.offload_opt_state:
+        offload("opt_state", "optimizer-state")
+    if policy.offload_params:
+        offload("params", "parameter")
     state = jax.jit(build, out_shardings=shardings)(rng)
     return state, shardings

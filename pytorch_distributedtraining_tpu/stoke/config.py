@@ -144,6 +144,11 @@ class DeepspeedOffloadOptimizerConfig:
 
 @dataclass
 class DeepspeedOffloadParamConfig:
+    """DeepSpeed offload_param twin: ``device='cpu'`` places params in
+    pinned host memory (``Policy.offload_params``), streamed to the chip
+    per step; backends without host placement fall back to device memory
+    with a warning (same rule as the optimizer-offload twin)."""
+
     device: str = "cpu"
     pin_memory: bool = False
 

@@ -269,6 +269,11 @@ class Stoke:
             offload_opt = offload_opt or (
                 ds_config.offload_optimizer.device == "cpu"
             )
+        offload_par = bool(
+            ds_config is not None
+            and ds_config.offload_param is not None
+            and ds_config.offload_param.device == "cpu"
+        )
         self.policy = policy_from_flags(
             distributed=distributed,
             fairscale_oss=fairscale_oss,
@@ -276,6 +281,7 @@ class Stoke:
             fairscale_fsdp=fairscale_fsdp,
             remat=self.tpu_config.remat,
             offload_opt_state=offload_opt,
+            offload_params=offload_par,
         )
         zero = fairscale_oss or fairscale_sddp or fairscale_fsdp
         if mesh is not None:
@@ -692,7 +698,8 @@ class Stoke:
         defaults True (static shapes — XLA recompiles on ragged tails)."""
         if batch_size is None:
             batch_size = self.batch_size_per_device * jax.local_device_count()
-        kwargs.pop("multiprocessing_context", None)  # torch parity no-op
+        # multiprocessing_context passes through: a spawn/fork context is a
+        # real process pool in the loader (GIL escape hatch), not a no-op
         return _DataLoader(
             dataset,
             batch_size=batch_size,
