@@ -378,6 +378,11 @@ def main():
             return nn.Dense(c, dtype=self.dtype, name="proj")(out)
 
     with_attention(BlockdiagAttn, "blockdiag_attn")
+    # production impls of the same two ideas (models/swinir.py attn_impl):
+    # the arms bench.py can run as full train steps via GRAFT_BENCH_ATTN —
+    # timed here too so profiler and bench numbers cross-check
+    ablate({"attn_impl": "blockdiag"}, "blockdiag_impl")
+    ablate({"attn_impl": "paired"}, "paired_impl")
 
     class PairedWindowAttn(swinir_mod.WindowAttention):
         """Two windows packed into one M=128 attention: scores become

@@ -52,8 +52,11 @@ run bench        420 python bench.py
 run profile      900 python benchmarks/profile_swinir.py
 run bench_pallas 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=pallas python bench.py
 run bench_packed 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=pallas GRAFT_BENCH_ATTN_PACK=2 python bench.py
+run bench_paired 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=paired python bench.py
+run bench_blockdiag 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=blockdiag python bench.py
 run bench_bf16ln 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_NORM=bf16 python bench.py
 run bench_combo  360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=pallas GRAFT_BENCH_ATTN_PACK=2 GRAFT_BENCH_NORM=bf16 python bench.py
+run bench_combo_paired 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=paired GRAFT_BENCH_NORM=bf16 python bench.py
 run bench_trace  360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_TRACE="$OUT/xplane" python bench.py
 run facade       600 python benchmarks/facade_bench.py
 run attn         600 python benchmarks/attn_bench.py

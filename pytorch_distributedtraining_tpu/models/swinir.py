@@ -135,9 +135,18 @@ class WindowAttention(nn.Module):
     window_size: int
     dtype: jnp.dtype = jnp.float32
     softmax_dtype: jnp.dtype = jnp.float32  # attention prob accumulation
-    # 'xla' einsum path, or 'pallas': the fused VMEM-resident kernel
-    # (ops/pallas_window_attn.py) that never writes the [bn, h, n, n]
-    # probabilities to HBM — same parameters, same math
+    # How the [bn, h, n, n] attention is computed — same parameters, same
+    # math for every choice (checkpoints are interchangeable):
+    #   'xla'       per-head einsums (baseline)
+    #   'pallas'    fused VMEM-resident kernel (ops/pallas_window_attn.py):
+    #               probabilities never round-trip HBM
+    #   'paired'    two windows packed into one [2n, 2n] attention with a
+    #               cross-window kill mask: score/AV matmuls fill full
+    #               128-row MXU tiles at ws=8 instead of two half-empty
+    #               64-row passes (BASELINE.md roofline lever)
+    #   'blockdiag' QK^T/AV as block-diagonal-packed gemms: contraction 60
+    #               instead of head_dim 10 (6x MXU K-utilization) at the
+    #               cost of materializing packed operands
     attn_impl: str = "xla"
     # pallas impl only: fuse this many windows per attention tile (2 packs
     # SwinIR's 64-token windows into full 128-row MXU tiles)
@@ -145,9 +154,10 @@ class WindowAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None):
-        if self.attn_impl not in ("xla", "pallas"):
+        if self.attn_impl not in ("xla", "pallas", "paired", "blockdiag"):
             raise ValueError(
-                f"attn_impl must be 'xla' or 'pallas', got {self.attn_impl!r}"
+                "attn_impl must be one of 'xla'/'pallas'/'paired'/"
+                f"'blockdiag', got {self.attn_impl!r}"
             )
         bn, n, c = x.shape  # [B*nW, ws^2, C]
         h = self.num_heads
@@ -163,6 +173,15 @@ class WindowAttention(nn.Module):
         )
         idx = _relative_position_index(self.window_size)
         bias = table[idx.reshape(-1)].reshape(n, n, h).transpose(2, 0, 1)
+
+        if self.attn_impl == "paired":
+            p = 2
+            if bn % p == 0 and (mask is None or mask.shape[0] % p == 0):
+                return self._paired(qkv, bias, mask, p)
+            # odd window counts are legal SwinIR inputs — fall back rather
+            # than failing mid-forward (mirrors the pallas pack fallback)
+        if self.attn_impl == "blockdiag":
+            return self._blockdiag(q, k, v, bias, mask)
 
         if self.attn_impl == "pallas":
             if self.softmax_dtype != jnp.float32:
@@ -207,6 +226,90 @@ class WindowAttention(nn.Module):
             attn.astype(self.softmax_dtype), axis=-1
         ).astype(self.dtype)
         out = (attn @ v).transpose(0, 2, 1, 3).reshape(bn, n, c)
+        return nn.Dense(c, dtype=self.dtype, name="proj")(out)
+
+    def _paired(self, qkv, bias, mask, p: int):
+        """Two windows per attention: [p*n, p*n] scores with an additive
+        cross-window kill mask (-100 -> softmax ~0, the shift-mask trick),
+        so each score/AV matmul runs a full ``p*n``-row MXU tile.
+        Unshifted layers may pair across image boundaries — the kill mask
+        zeroes every cross-window probability, so pairing is image-blind.
+        """
+        q, k, v = qkv[0], qkv[1], qkv[2]  # [bn, h, n, d]
+        bn, h, n, d = q.shape
+        c = h * d
+
+        def pack(t):  # [bn, h, n, d] -> [bn/p, h, p*n, d]
+            return t.reshape(bn // p, p, h, n, d).transpose(
+                0, 2, 1, 3, 4
+            ).reshape(bn // p, h, p * n, d)
+
+        q, k, v = pack(q), pack(k), pack(v)
+        attn = (q * d**-0.5) @ k.transpose(0, 1, 3, 2)  # [bn/p, h, pn, pn]
+
+        eye = jnp.eye(p, dtype=bias.dtype)
+        bias_pair = jnp.einsum("ab,hnm->hanbm", eye, bias).reshape(
+            h, p * n, p * n
+        )
+        kill = (1.0 - jnp.eye(p, dtype=jnp.float32)) * -100.0
+        kill = jnp.repeat(jnp.repeat(kill, n, 0), n, 1)  # [pn, pn]
+        attn = attn + (bias_pair + kill.astype(bias.dtype)[None]).astype(
+            attn.dtype
+        )[None]
+
+        if mask is not None:  # [nW, n, n] per-window shift mask
+            nw = mask.shape[0]
+            m = jnp.asarray(mask).reshape(nw // p, p, n, n)
+            m_pair = jnp.einsum(
+                "ab,wanm->wanbm", eye.astype(m.dtype), m
+            ).reshape(nw // p, p * n, p * n)
+            attn = attn.reshape(
+                bn // nw, nw // p, h, p * n, p * n
+            ) + m_pair[None, :, None].astype(attn.dtype)
+            attn = attn.reshape(bn // p, h, p * n, p * n)
+
+        attn = jax.nn.softmax(
+            attn.astype(self.softmax_dtype), axis=-1
+        ).astype(self.dtype)
+        out = attn @ v  # [bn/p, h, p*n, d]
+        out = out.reshape(bn // p, h, p, n, d).transpose(
+            0, 2, 3, 1, 4
+        ).reshape(bn, n, c)
+        return nn.Dense(c, dtype=self.dtype, name="proj")(out)
+
+    def _blockdiag(self, q, k, v, bias, mask):
+        """QK^T / AV as single block-diagonal-packed gemms per window:
+        contraction ``h*d`` (60) instead of ``d`` (10) — 6x MXU
+        K-utilization — at the cost of materializing packed operands."""
+        import jax.scipy.linalg as jsp
+
+        bn, h, n, d = q.shape
+        c = h * d
+
+        kT = k.transpose(0, 1, 3, 2)  # [bn, h, d, n]
+        kblk = jax.vmap(
+            lambda ks: jsp.block_diag(*[ks[i] for i in range(h)])
+        )(kT)  # [bn, h*d, h*n]
+        q2 = q.transpose(0, 2, 1, 3).reshape(bn, n, c)
+        s = (q2 * d**-0.5) @ kblk  # [bn, n, h*n]
+        attn = s.reshape(bn, n, h, n).transpose(0, 2, 1, 3)
+
+        attn = attn + bias[None].astype(attn.dtype)
+        if mask is not None:
+            nw = mask.shape[0]
+            attn = attn.reshape(bn // nw, nw, h, n, n) + mask[
+                None, :, None
+            ].astype(attn.dtype)
+            attn = attn.reshape(bn, h, n, n)
+        attn = jax.nn.softmax(
+            attn.astype(self.softmax_dtype), axis=-1
+        ).astype(self.dtype)
+
+        vblk = jax.vmap(
+            lambda vs: jsp.block_diag(*[vs[i] for i in range(h)])
+        )(v)  # [bn, h*n, h*d]
+        p2 = attn.transpose(0, 2, 1, 3).reshape(bn, n, h * n)
+        out = p2 @ vblk  # heads already concatenated
         return nn.Dense(c, dtype=self.dtype, name="proj")(out)
 
 
@@ -307,7 +410,8 @@ class SwinIR(nn.Module):
     # see benchmarks/profile_swinir.py) at ~1e-2 output tolerance.
     norm_dtype: jnp.dtype = jnp.float32
     softmax_dtype: jnp.dtype = jnp.float32  # attention softmax accumulation
-    # 'xla' | 'pallas' — see WindowAttention.attn_impl
+    # 'xla' | 'pallas' | 'paired' | 'blockdiag' — see
+    # WindowAttention.attn_impl for what each computes
     attn_impl: str = "xla"
     attn_pack: int = 1  # pallas impl: windows fused per attention tile
 

@@ -124,3 +124,67 @@ def test_swinir_trains(mesh8):
         state, m = step(state, batch)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0]
+
+
+def test_attn_impl_variants_match_xla():
+    """'paired' (two windows per full MXU tile) and 'blockdiag' (packed
+    contraction) are pure compute-layout changes: same params, same math,
+    bit-close outputs vs the 'xla' baseline — on both the unshifted and
+    shifted (mask) layers (depths=[2] covers W-MSA + SW-MSA)."""
+    kw = dict(upscale=2, window_size=8, depths=[2], embed_dim=12,
+              num_heads=[2], mlp_ratio=2)
+    x = jnp.asarray(
+        np.random.default_rng(0).random((2, 16, 16, 3)), jnp.float32
+    )
+    base = SwinIR(**kw)
+    params = base.init(jax.random.PRNGKey(1), x)["params"]
+    ref = np.asarray(base.apply({"params": params}, x))
+    for impl in ("paired", "blockdiag"):
+        out = np.asarray(
+            SwinIR(**kw, attn_impl=impl).apply({"params": params}, x)
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-5, err_msg=impl)
+
+
+def test_paired_attn_falls_back_on_odd_window_count():
+    """A 24x24 input gives 9 windows per image — indivisible by the pack
+    of 2, so 'paired' must fall back to the unpaired math, not fail."""
+    kw = dict(upscale=2, window_size=8, depths=[2], embed_dim=12,
+              num_heads=[2], mlp_ratio=2)
+    x = jnp.asarray(
+        np.random.default_rng(2).random((1, 24, 24, 3)), jnp.float32
+    )
+    base = SwinIR(**kw)
+    params = base.init(jax.random.PRNGKey(1), x)["params"]
+    ref = np.asarray(base.apply({"params": params}, x))
+    out = np.asarray(
+        SwinIR(**kw, attn_impl="paired").apply({"params": params}, x)
+    )
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def test_paired_attn_cross_image_pairs_are_killed():
+    """B=2 at 24x24: 9 windows per image, bn=18 even, so the unshifted
+    layers pair window 8 of image 0 with window 0 of image 1. The kill
+    mask must zero every cross-window probability — outputs equal the
+    unpaired baseline, proving pairing is image-blind with no leakage."""
+    kw = dict(upscale=2, window_size=8, depths=[2], embed_dim=12,
+              num_heads=[2], mlp_ratio=2)
+    x = jnp.asarray(
+        np.random.default_rng(3).random((2, 24, 24, 3)), jnp.float32
+    )
+    base = SwinIR(**kw)
+    params = base.init(jax.random.PRNGKey(1), x)["params"]
+    ref = np.asarray(base.apply({"params": params}, x))
+    out = np.asarray(
+        SwinIR(**kw, attn_impl="paired").apply({"params": params}, x)
+    )
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def test_attn_impl_rejects_unknown():
+    with pytest.raises(ValueError, match="attn_impl"):
+        SwinIR(depths=[1], embed_dim=12, num_heads=[2],
+               attn_impl="winograd").init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3))
+        )
