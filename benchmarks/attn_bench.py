@@ -25,7 +25,9 @@ def main():
 
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir", f"/tmp/jax_bench_cache_{os.getuid()}")
+    from pytorch_distributedtraining_tpu.runtime.cache import cache_dir
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir("bench"))
 
     from pytorch_distributedtraining_tpu.models.gpt2 import default_attention
     from pytorch_distributedtraining_tpu.ops.pallas_attn import flash_attention

@@ -200,7 +200,9 @@ def main():
         jax.config.update("jax_platforms", "cpu")  # sitecustomize latch
     if not TINY:  # the analytic model describes the full-size config only
         print(json.dumps(analytic_model()), flush=True)
-    jax.config.update("jax_compilation_cache_dir", f"/tmp/jax_bench_cache_{os.getuid()}")
+    from pytorch_distributedtraining_tpu.runtime.cache import cache_dir
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir("bench"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     model = SwinIR(dtype=jnp.bfloat16, **MODEL_KW)
     batch = make_batch(BATCH)

@@ -57,6 +57,7 @@ run bench_blockdiag 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=blockdiag pyt
 run bench_bf16ln 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_NORM=bf16 python bench.py
 run bench_combo  360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=pallas GRAFT_BENCH_ATTN_PACK=2 GRAFT_BENCH_NORM=bf16 python bench.py
 run bench_combo_paired 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=paired GRAFT_BENCH_NORM=bf16 python bench.py
+run bench_b36    360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_BATCH=36 python bench.py
 run bench_trace  360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_TRACE="$OUT/xplane" python bench.py
 run facade       600 python benchmarks/facade_bench.py
 run attn         600 python benchmarks/attn_bench.py

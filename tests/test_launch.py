@@ -17,7 +17,9 @@ CHILD = """
 import os
 import jax
 
-jax.config.update("jax_compilation_cache_dir", f"/tmp/jax_test_compile_cache_{os.getuid()}")
+from pytorch_distributedtraining_tpu.runtime.cache import cache_dir
+
+jax.config.update("jax_compilation_cache_dir", cache_dir("test_compile"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 from pytorch_distributedtraining_tpu.runtime import dist
@@ -118,8 +120,8 @@ def test_elastic_restart_resumes_from_checkpoint(tmp_path):
         "import os, sys\n"
         "import numpy as np\n"
         "import jax\n"
-        "jax.config.update('jax_compilation_cache_dir',\n"
-        "                  f'/tmp/jax_test_compile_cache_{os.getuid()}')\n"
+        "from pytorch_distributedtraining_tpu.runtime.cache import cache_dir\n"
+        "jax.config.update('jax_compilation_cache_dir', cache_dir('test_compile'))\n"
         "jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0)\n"
         "from pytorch_distributedtraining_tpu.runtime import dist\n"
         "dist.initialize()\n"

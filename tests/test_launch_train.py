@@ -22,7 +22,9 @@ import numpy as np
 import jax
 
 # children miss the parent's persistent compile cache unless told about it
-jax.config.update("jax_compilation_cache_dir", f"/tmp/jax_test_compile_cache_{os.getuid()}")
+from pytorch_distributedtraining_tpu.runtime.cache import cache_dir
+
+jax.config.update("jax_compilation_cache_dir", cache_dir("test_compile"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 from pytorch_distributedtraining_tpu.runtime import dist
