@@ -342,6 +342,42 @@ def test_eval_step_matches_eager_validation():
         np.testing.assert_allclose(float(host[k]), eager[k], rtol=2e-5)
 
 
+def test_eval_step_honors_sharded_policy(zero_mesh8):
+    """eval_step under ZeRO-3 (fairscale_fsdp): params keep their sharded
+    placement — no implicit all-gather onto one device — and the metrics
+    match the eager forward."""
+    s = _stoke(
+        fairscale_fsdp=True,
+        fairscale_oss=False,
+        fairscale_sddp=False,
+        grad_accum_steps=1,
+        mesh=zero_mesh8,
+    )
+    x, y = _batch()
+    s.init(x)
+    assert s.policy.shard_params
+    # at least one param leaf is genuinely sharded before eval
+    kernels = [p for p in jax.tree.leaves(s.state.params) if p.ndim == 4]
+    assert any(
+        k.addressable_shards[0].data.shape != k.shape for k in kernels
+    )
+    s.model_access.eval()
+    step = s.eval_step({"mae": metrics.mae})
+    m = jax.device_get(step(x, y))
+    out = s.model(x)
+    np.testing.assert_allclose(
+        float(m["loss"]), float(s.loss(out, y)), rtol=2e-5
+    )
+    np.testing.assert_allclose(
+        float(m["mae"]), float(metrics.mae(out, y)), rtol=2e-5
+    )
+    # params untouched and still sharded after the compiled eval
+    assert any(
+        k.addressable_shards[0].data.shape != k.shape
+        for k in jax.tree.leaves(s.state.params) if k.ndim == 4
+    )
+
+
 def test_fp16_amp_option():
     s = _stoke(fp16=FP16Options.amp.value, grad_accum_steps=1)
     x, y = _batch()
