@@ -32,16 +32,20 @@ def adamw(
     eps: float = 1e-8,
     weight_decay: float = 0.01,
     clip_grad_norm: float | None = None,
+    clip_grad_value: float | None = None,
 ) -> optax.GradientTransformation:
     """AdamW with torch-parity argument names.
 
     ``clip_grad_norm`` fuses global-norm clipping into the chain (twin of
     ``ClipGradNormConfig(clip=0.1)``, `Stoke-DDP.py:253,164` — torch clips
-    before the step; here it's one XLA-fused chain).
+    before the step; here it's one XLA-fused chain). ``clip_grad_value``
+    is the elementwise clip twin (stoke ``ClipGradConfig``).
     """
     chain = []
     if clip_grad_norm is not None:
         chain.append(optax.clip_by_global_norm(clip_grad_norm))
+    if clip_grad_value is not None:
+        chain.append(optax.clip(clip_grad_value))
     chain.append(
         optax.adamw(
             learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
@@ -57,10 +61,13 @@ def sgd(
     weight_decay: float = 0.0,
     nesterov: bool = False,
     clip_grad_norm: float | None = None,
+    clip_grad_value: float | None = None,
 ) -> optax.GradientTransformation:
     chain = []
     if clip_grad_norm is not None:
         chain.append(optax.clip_by_global_norm(clip_grad_norm))
+    if clip_grad_value is not None:
+        chain.append(optax.clip(clip_grad_value))
     if weight_decay:
         chain.append(optax.add_decayed_weights(weight_decay))
     chain.append(optax.sgd(lr, momentum=momentum or None, nesterov=nesterov))

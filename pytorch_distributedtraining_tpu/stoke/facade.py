@@ -41,6 +41,7 @@ from ..runtime import dist as _dist
 from ..runtime.mesh import MeshSpec, batch_spec, make_mesh
 from .config import (
     AMPConfig,
+    ClipGradConfig,
     ClipGradNormConfig,
     DDPConfig,
     DeepspeedConfig,
@@ -227,7 +228,7 @@ class Stoke:
         fairscale_fsdp: bool = False,
         grad_accum_steps: int = 1,
         configs: list | None = None,
-        grad_clip: ClipGradNormConfig | None = None,
+        grad_clip: ClipGradNormConfig | ClipGradConfig | None = None,
         *,
         sample_input=None,
         pretrained=None,
@@ -300,14 +301,27 @@ class Stoke:
 
         # -- precision -----------------------------------------------------
         fp16 = fp16.value if isinstance(fp16, FP16Options) else fp16
+        if fp16 is None and ds_config is not None:
+            # DeepSpeed's own precision switches (json-config parity):
+            # honored only when the ctor's fp16 arg doesn't already decide
+            if ds_config.bf16_enabled:
+                fp16 = "bf16"
+            elif ds_config.fp16_enabled:
+                fp16 = "amp"
         self.fp16 = fp16
         if fp16 in ("amp", "apex_O1", "apex_O2", "deepspeed"):
+            # AMPConfig.enabled=False is torch GradScaler(enabled=False):
+            # fp16 compute stays, the scaler becomes a pass-through
             self.precision = PrecisionPolicy.from_name("fp16")
-            self.loss_scaler = DynamicLossScaler(
-                init_scale=self.amp_config.init_scale,
-                growth_factor=self.amp_config.growth_factor,
-                backoff_factor=self.amp_config.backoff_factor,
-                growth_interval=self.amp_config.growth_interval,
+            self.loss_scaler = (
+                DynamicLossScaler(
+                    init_scale=self.amp_config.init_scale,
+                    growth_factor=self.amp_config.growth_factor,
+                    backoff_factor=self.amp_config.backoff_factor,
+                    growth_interval=self.amp_config.growth_interval,
+                )
+                if self.amp_config.enabled
+                else None
             )
         elif fp16 == "bf16":
             self.precision = PrecisionPolicy.from_name("bf16")
@@ -322,7 +336,21 @@ class Stoke:
         factory, kwargs = StokeOptimizer.resolve(optimizer)
         self._base_lr = float(kwargs.pop("lr", 1e-3))
         if grad_clip is not None:
-            kwargs.setdefault("clip_grad_norm", grad_clip.max_norm)
+            # both stoke clip twins: ClipGradNormConfig (global norm) and
+            # ClipGradConfig (elementwise value)
+            if isinstance(grad_clip, ClipGradNormConfig):
+                kwargs.setdefault("clip_grad_norm", grad_clip.max_norm)
+            elif isinstance(grad_clip, ClipGradConfig):
+                kwargs.setdefault("clip_grad_value", grad_clip.clip)
+            else:
+                raise TypeError(
+                    f"grad_clip must be ClipGradNormConfig or "
+                    f"ClipGradConfig, got {type(grad_clip).__name__}"
+                )
+        elif ds_config is not None and ds_config.gradient_clipping:
+            # DeepSpeed json-config clip (global norm), when no explicit
+            # grad_clip argument takes precedence
+            kwargs.setdefault("clip_grad_norm", ds_config.gradient_clipping)
         # lr=1.0: the real lr rides the OptimizerHandle and is applied as a
         # runtime scalar, so torch-style schedulers never retrace anything
         self._tx = factory(lr=1.0, **kwargs)
@@ -422,18 +450,28 @@ class Stoke:
         self._jit_fwd = jax.jit(fwd, static_argnames=("train",))
         self._jit_loss = jax.jit(lambda o, t: loss_callable(o, t))
 
+        def fwd_loss(p, model_state, x, y, rng):
+            out, new_state = self._apply_model(
+                precision.cast_to_compute(p), model_state, x, True, rng
+            )
+            loss = loss_callable(out, y)
+            return loss, precision.cast_to_output(out), new_state
+
+        if self.policy.remat:
+            # the eager .backward() path honors Policy.remat too (the
+            # fused TrainStep wires it separately): backward recomputes
+            # the forward instead of holding its activations
+            fwd_loss = jax.checkpoint(fwd_loss)
+
         def loss_grad(params, model_state, x, y, rng, scaler_state):
             def lfn(p):
-                out, new_state = self._apply_model(
-                    precision.cast_to_compute(p), model_state, x, True, rng
-                )
-                loss = loss_callable(out, y)
+                loss, out, new_state = fwd_loss(p, model_state, x, y, rng)
                 scaled = (
                     loss * scaler_state.scale.astype(loss.dtype)
                     if scaler_state is not None
                     else loss
                 )
-                return scaled, (loss, precision.cast_to_output(out), new_state)
+                return scaled, (loss, out, new_state)
 
             (_, (loss, out, new_state)), grads = jax.value_and_grad(
                 lfn, has_aux=True

@@ -396,3 +396,96 @@ def test_uninitialized_save_raises():
     s = _stoke()
     with pytest.raises(RuntimeError, match="not initialized"):
         s.save()
+
+
+def test_grad_clip_value_config():
+    """stoke's second clip twin: ClipGradConfig (elementwise value clip)
+    is accepted by the facade and actually bounds the update."""
+    from pytorch_distributedtraining_tpu.stoke import ClipGradConfig
+
+    s = _stoke(
+        grad_clip=ClipGradConfig(clip=1e-4), grad_accum_steps=1,
+        optimizer=StokeOptimizer(
+            optimizer="SGD", optimizer_kwargs={"lr": 1.0},
+        ),
+    )
+    x, y = _batch()
+    s.init(x)
+    before = jax.tree.map(np.asarray, jax.device_get(s.state.params))
+    s.fused_step(x, y)
+    after = jax.device_get(s.state.params)
+    deltas = [
+        np.max(np.abs(np.asarray(a) - b))
+        for a, b in zip(jax.tree.leaves(after), jax.tree.leaves(before))
+    ]
+    assert max(deltas) <= 1e-4 + 1e-7, max(deltas)  # |update| <= lr*clip
+    assert max(deltas) > 0  # but training still moves
+
+    class Bogus:
+        pass
+
+    with pytest.raises(TypeError, match="grad_clip"):
+        _stoke(grad_clip=Bogus())
+
+
+def test_deepspeed_config_precision_and_clip_wiring():
+    """DeepspeedConfig's own switches are honored when the ctor doesn't
+    already decide: bf16_enabled/fp16_enabled pick the precision,
+    gradient_clipping feeds the global-norm clip, and
+    AMPConfig(enabled=False) disables the scaler like torch's
+    GradScaler(enabled=False)."""
+    from pytorch_distributedtraining_tpu.stoke import DeepspeedConfig
+
+    s = _stoke(configs=[DeepspeedConfig(bf16_enabled=True)],
+               grad_clip=None, fp16=None)
+    assert s.fp16 == "bf16" and s.loss_scaler is None
+
+    s = _stoke(configs=[DeepspeedConfig(fp16_enabled=True,
+                                        gradient_clipping=0.5)],
+               grad_clip=None, fp16=None)
+    assert s.fp16 == "amp" and s.loss_scaler is not None
+
+    # explicit ctor fp16 wins over the DeepSpeed switch
+    s = _stoke(configs=[DeepspeedConfig(fp16_enabled=True)],
+               grad_clip=None, fp16=FP16Options.bf16.value)
+    assert s.fp16 == "bf16"
+
+    # scaler disabled but fp16 compute kept
+    s = _stoke(configs=[AMPConfig(init_scale=2.0**14, enabled=False)],
+               fp16=FP16Options.amp.value, grad_accum_steps=1)
+    assert s.loss_scaler is None
+    x, y = _batch()
+    m = s.fused_step(x, y)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_remat_applies_to_eager_backward_path():
+    """TPUConfig(remat=True) must not be inert on the reference-shaped
+    eager loop: the .backward() program carries a remat region, and the
+    trajectory matches the non-remat facade exactly."""
+    from pytorch_distributedtraining_tpu.stoke import TPUConfig
+
+    x, y = _batch(seed=13)
+    s_rm = _stoke(configs=[TPUConfig(remat=True)], grad_accum_steps=1)
+    s_nr = _stoke(grad_accum_steps=1)
+    for s in (s_rm, s_nr):
+        out = s.model(x)
+        l = s.loss(out, y)
+        s.backward(l)
+        s.step()
+    assert s_rm.policy.remat and not s_nr.policy.remat
+    for a, b in zip(
+        jax.tree.leaves(s_rm.state.params), jax.tree.leaves(s_nr.state.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def grad_jaxpr(s):
+        return str(jax.make_jaxpr(
+            lambda p: s._jit_loss_grad.__wrapped__(
+                p, s._state.model_state, s._shard_batch(x),
+                s._shard_batch(y), s._state.rng, s._state.scaler,
+            )
+        )(s._state.params).jaxpr)
+
+    assert "remat" in grad_jaxpr(s_rm)
+    assert "remat" not in grad_jaxpr(s_nr)
