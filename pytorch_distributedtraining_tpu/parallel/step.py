@@ -67,6 +67,7 @@ class TrainStep:
         extra_metrics: bool = True,
         donate: bool = True,
         detect_anomaly: bool = False,
+        update_wire_dtype=None,
     ):
         self.loss_fn = loss_fn
         self.tx = tx
@@ -90,6 +91,13 @@ class TrainStep:
         # donate=False so the pre-step state survives for inspection when
         # the (possibly async) callback error surfaces.
         self.detect_anomaly = detect_anomaly
+        # Fairscale OSS broadcast_fp16 twin (`Stoke-DDP.py:197-199`): under
+        # ZeRO the optimizer update is computed on sharded state and fans
+        # out through an implicit all-gather; casting the update to a
+        # narrow wire dtype before the add halves that fan-out traffic —
+        # the same deliberate lossiness as the reference's fp16 param
+        # broadcast (bf16 here: TPU-native, same 2-byte wire).
+        self.update_wire_dtype = update_wire_dtype
         if detect_anomaly:
             donate = False
 
@@ -184,6 +192,12 @@ class TrainStep:
 
         updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
         updates = jax.tree.map(lambda u: u * lr_factor, updates)  # plateau
+        if self.update_wire_dtype is not None:
+            # narrow the fan-out wire (see ctor comment); the add below
+            # upcasts back to the param dtype
+            updates = jax.tree.map(
+                lambda u: u.astype(self.update_wire_dtype), updates
+            )
         new_params = optax.apply_updates(state.params, updates)
 
         if self.loss_scaler is not None:

@@ -423,6 +423,23 @@ class Stoke:
             )
         return self
 
+    def _update_wire_dtype(self):
+        """Fairscale OSS ``broadcast_fp16`` twin (`Stoke-DDP.py:197-199`):
+        under a ZeRO policy the sharded-state update fans out through an
+        implicit all-gather; the flag narrows that wire to bf16 (the
+        TPU-native 2-byte dtype, same deliberate lossiness as the
+        reference's fp16 param broadcast). No-op for plain DDP or a
+        single-device mesh — there is no fan-out to compress."""
+        from ..parallel.spec import shard_axis
+
+        if (
+            self.oss_config.broadcast_fp16
+            and self.policy.shard_opt_state
+            and shard_axis(self.mesh) is not None
+        ):
+            return jnp.bfloat16
+        return None
+
     def _apply_model(self, params, model_state, x, train: bool, rng):
         variables = {"params": params, **model_state}
         kwargs = {}
@@ -494,6 +511,8 @@ class Stoke:
         mesh = self.mesh
         scaler = self.loss_scaler
 
+        wire_dtype = self._update_wire_dtype()
+
         def apply_updates(params, opt_state, scaler_state, grads, lr):
             finite = jnp.bool_(True)
             new_scaler = scaler_state
@@ -506,6 +525,11 @@ class Stoke:
                 grads = constrain(grads, gspecs, mesh)
             updates, new_opt = tx.update(grads, opt_state, params)
             updates = jax.tree.map(lambda u: u * lr, updates)
+            if wire_dtype is not None:
+                # OSS broadcast_fp16 twin: narrow the update fan-out wire
+                updates = jax.tree.map(
+                    lambda u: u.astype(wire_dtype), updates
+                )
             new_params = jax.tree.map(lambda p, u: p + u, params, updates)
             if scaler is not None:
                 new_params = jax.tree.map(
@@ -710,6 +734,7 @@ class Stoke:
                 loss_scaler=self.loss_scaler,
                 state_shardings=self._shardings,
                 donate=self.tpu_config.donate_state,
+                update_wire_dtype=self._update_wire_dtype(),
             )
         self._state, metrics = self._fused(
             self._state,

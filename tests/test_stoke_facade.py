@@ -405,6 +405,10 @@ def test_grad_clip_value_config():
 
     s = _stoke(
         grad_clip=ClipGradConfig(clip=1e-4), grad_accum_steps=1,
+        # keep the ZeRO-2 path but silence broadcast_fp16: the wire
+        # narrowing would round the clipped update by up to ~0.4% and blur
+        # the exact bound asserted below
+        configs=[FairscaleOSSConfig(broadcast_fp16=False)],
         optimizer=StokeOptimizer(
             optimizer="SGD", optimizer_kwargs={"lr": 1.0},
         ),
@@ -466,8 +470,13 @@ def test_remat_applies_to_eager_backward_path():
     from pytorch_distributedtraining_tpu.stoke import TPUConfig
 
     x, y = _batch(seed=13)
-    s_rm = _stoke(configs=[TPUConfig(remat=True)], grad_accum_steps=1)
-    s_nr = _stoke(grad_accum_steps=1)
+    # broadcast_fp16 off: bf16 update rounding would amplify remat's
+    # bitwise-different grad reassociation past the exactness tolerance
+    s_rm = _stoke(
+        configs=[TPUConfig(remat=True), FairscaleOSSConfig()],
+        grad_accum_steps=1,
+    )
+    s_nr = _stoke(configs=[FairscaleOSSConfig()], grad_accum_steps=1)
     for s in (s_rm, s_nr):
         out = s.model(x)
         l = s.loss(out, y)
@@ -489,3 +498,37 @@ def test_remat_applies_to_eager_backward_path():
 
     assert "remat" in grad_jaxpr(s_rm)
     assert "remat" not in grad_jaxpr(s_nr)
+
+
+def test_oss_broadcast_fp16_narrows_update_wire():
+    """FairscaleOSSConfig(broadcast_fp16=True) under a ZeRO policy casts
+    the post-step update fan-out to bf16 — params move by bf16-rounded
+    updates (the reference's lossy fp16 broadcast twin); with the flag
+    off, updates apply at full f32."""
+    x, y = _batch(seed=17)
+    kw = dict(
+        grad_accum_steps=1, grad_clip=None,
+        optimizer=StokeOptimizer(optimizer="SGD",
+                                 optimizer_kwargs={"lr": 0.25}),
+    )
+    s_on = _stoke(configs=[FairscaleOSSConfig(broadcast_fp16=True)], **kw)
+    s_off = _stoke(configs=[FairscaleOSSConfig(broadcast_fp16=False)], **kw)
+    assert s_on._update_wire_dtype() == jnp.bfloat16
+    assert s_off._update_wire_dtype() is None
+    for s in (s_on, s_off):
+        s.init(x)
+        s.fused_step(x, y)
+    # same seed/init: the two runs differ exactly by bf16 rounding of the
+    # update (absolute error <= one bf16 ulp of the update magnitude) —
+    # close in absolute terms, but not bitwise equal
+    close = all(
+        np.allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+        for a, b in zip(jax.tree.leaves(s_on.state.params),
+                        jax.tree.leaves(s_off.state.params))
+    )
+    identical = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(s_on.state.params),
+                        jax.tree.leaves(s_off.state.params))
+    )
+    assert close and not identical
