@@ -108,9 +108,10 @@ def render(results_dir: str) -> str:
         )
         if best != "bench":
             line += (
-                " To make this the default, flip the matching fields in "
-                "`bench.py:_bench` (and the SwinIR defaults if quality "
-                "tolerances hold)."
+                " To make this the default, commit the matching knobs as "
+                "`bench_knobs.json` at the repo root (env > json > "
+                "built-in; keys attn/attn_pack/norm/softmax) — and the "
+                "SwinIR defaults if quality tolerances hold."
             )
         out += ["", line]
     out.append("")
