@@ -402,7 +402,13 @@ def _bench() -> None:
     knobs_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_knobs.json"
     )
-    if os.path.exists(knobs_path):
+    # GRAFT_BENCH_KNOBS=0 ignores the file: the A/B chain pins every arm
+    # with explicit env so a committed winner can't contaminate the
+    # baseline or stack under the single-knob ablation arms
+    if (
+        os.environ.get("GRAFT_BENCH_KNOBS") != "0"
+        and os.path.exists(knobs_path)
+    ):
         try:
             with open(knobs_path) as fh:
                 knobs = json.load(fh)
@@ -410,12 +416,19 @@ def _bench() -> None:
             # fail fast with the named cause: a raw traceback would burn
             # every retry attempt on the same unreadable file
             raise SystemExit(f"bench_knobs.json unreadable: {e}")
+        unknown = set(knobs) - {"attn", "attn_pack", "norm", "softmax"}
+        if unknown:
+            # a typoed key would otherwise silently no-op the default flip
+            raise SystemExit(
+                f"bench_knobs.json unknown keys {sorted(unknown)}; valid: "
+                "attn, attn_pack, norm, softmax"
+            )
 
     resolved = {}  # effective value + where it came from, for the log line
 
     def knob(env_name: str, file_key: str, default: str) -> str:
         env = os.environ.get(env_name)
-        if env:
+        if env is not None:  # set-but-empty still wins: env is authoritative
             resolved[file_key] = (env, "env")
             return env
         if file_key in knobs:

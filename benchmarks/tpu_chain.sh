@@ -21,6 +21,9 @@ if [ -z "$_CDIR" ]; then
 fi
 export JAX_COMPILATION_CACHE_DIR="$_CDIR"
 export PYTHONPATH="$PWD:${PYTHONPATH:-}"
+# A/B arms must be pure: ignore a committed bench_knobs.json so the
+# baseline stays built-in defaults and single-knob arms don't stack
+export GRAFT_BENCH_KNOBS=0
 log() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$OUT/watch.log"; }
 
 log "watcher start"

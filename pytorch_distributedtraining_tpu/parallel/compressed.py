@@ -210,13 +210,11 @@ class CompressedGradStep:
             k: v for k, v in state.model_state.items() if k != "grad_residual"
         }
         n_lead = 2 if self.ici_axis else 1
+        # gspecs double as the out_specs: the reduced leaf each shard
+        # HOLDS (its owned slice under ZeRO-2) reassembles through them
         gspecs = jax.tree.map(
             lambda p: self._grad_spec(p.shape), state.params
         )
-        # the reduced leaf each shard HOLDS: its owned slice under ZeRO-2
-        # on a pure-dp mesh comes back whole through out_specs; on a hybrid
-        # mesh the fsdp slice reassembles over fsdp
-        out_gspecs = gspecs
 
         def local(params, residuals, batch):
             residuals = jax.tree.map(
@@ -256,7 +254,7 @@ class CompressedGradStep:
             local,
             mesh=self.mesh,
             in_specs=(pspec, rspec, bspec),
-            out_specs=(P(), out_gspecs, rspec),
+            out_specs=(P(), gspecs, rspec),
             check_vma=False,  # reductions are replicated/owned by construction
         )(state.params, residuals, batch)
 

@@ -35,7 +35,7 @@ from .. import optim as optim_mod
 from ..data import DataLoader as _DataLoader
 from ..ops import sync_scalar_device
 from ..parallel import TrainStep, create_train_state, policy_from_flags
-from ..parallel.spec import constrain
+from ..parallel.spec import constrain, shard_axis
 from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
 from ..runtime import dist as _dist
 from ..runtime.mesh import MeshSpec, batch_spec, make_mesh
@@ -430,8 +430,6 @@ class Stoke:
         TPU-native 2-byte dtype, same deliberate lossiness as the
         reference's fp16 param broadcast). No-op for plain DDP or a
         single-device mesh — there is no fan-out to compress."""
-        from ..parallel.spec import shard_axis
-
         if (
             self.oss_config.broadcast_fp16
             and self.policy.shard_opt_state
