@@ -52,7 +52,7 @@ run() { # name, timeout, cmd...
 # priority order: headline first, then the MFU ablation data, then the
 # knob-candidate A/B bench reruns (cheap, warm cache), then the rest
 run bench        420 python bench.py
-run profile      900 python benchmarks/profile_swinir.py
+run profile     1800 python benchmarks/profile_swinir.py
 run bench_pallas 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=pallas python bench.py
 run bench_packed 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=pallas GRAFT_BENCH_ATTN_PACK=2 python bench.py
 run bench_paired 360 env GRAFT_BENCH_TOTAL=330 GRAFT_BENCH_ATTN=paired python bench.py
