@@ -212,6 +212,23 @@ def _run_child(
     return (None if timed_out else proc.returncode), out_lines, diag
 
 
+def _informative_tail(diag: list[str]) -> str:
+    """Last diagnostic line that isn't XLA:CPU's same-machine AOT false
+    positive (see runtime/cache.py) — that chatter would bury the real
+    failure cause in the error record. When nothing else remains, the
+    last progress line at least names the phase the child died in."""
+    informative = [
+        l for l in diag
+        if l.strip()
+        and "cpu_aot_loader" not in l
+        and "machine features" not in l
+    ]
+    return next(
+        (l for l in reversed(informative) if not l.startswith("#")),
+        informative[-1] if informative else "no output",
+    )
+
+
 def _extract_json_line(lines: list[str]) -> str | None:
     """Last line that parses as the result record, if any."""
     for line in reversed(lines):
@@ -264,7 +281,7 @@ def main() -> None:
         {"_GRAFT_BENCH_PROBE": "1"}, min(PROBE_TIMEOUT_S, _remaining() - 10)
     )
     probe_dt = time.monotonic() - t0
-    tail = diag[-1][:300] if diag else "no output"
+    tail = _informative_tail(diag)[:300]
     if rc is None:
         _emit_error(
             f"TPU backend init probe hung >{PROBE_TIMEOUT_S:.0f}s "
@@ -294,10 +311,7 @@ def main() -> None:
         result = _extract_json_line(out)
         if rc == 0 and result is not None:
             _emit_result(result)
-        tail = next(
-            (l for l in reversed(diag) if l.strip() and not l.startswith("#")),
-            diag[-1] if diag else "no output",
-        )
+        tail = _informative_tail(diag)
         err = (
             f"attempt {attempt} "
             + ("timed out" if rc is None else f"rc={rc}")

@@ -6,7 +6,17 @@
 # harvest_results.py at the end. Run detached during a pool outage:
 #     setsid benchmarks/tpu_chain.sh < /dev/null > /dev/null 2>&1 &
 set -u
-cd "$(dirname "$0")/.."
+# GRAFT_REPO override: lets a snapshot COPY of this script run (the safe
+# pattern while the committed file is being edited — bash reads running
+# scripts by byte offset). Guard against a wrong root either way.
+cd "${GRAFT_REPO:-$(cd "$(dirname "$0")/.." && pwd)}" || {
+  echo "FATAL: cannot cd to ${GRAFT_REPO:-<script>/..}" >&2
+  exit 1
+}
+if [ ! -f pytorch_distributedtraining_tpu/_hostfp.py ]; then
+  echo "FATAL: $PWD is not the repo root (set GRAFT_REPO)" >&2
+  exit 1
+fi
 OUT="$(readlink -f "${GRAFT_RESULTS:-/tmp/tpu_results}")"
 mkdir -p "$OUT"
 # machine-keyed (CPU-flags hash): a cache image copied from another host
