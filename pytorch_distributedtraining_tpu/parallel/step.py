@@ -25,8 +25,10 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import optax
+from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding
 
+from ..optim import FusedAdamW
 from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
 from ..runtime.mesh import batch_spec
 from .policy import Policy
@@ -98,6 +100,20 @@ class TrainStep:
         # the same deliberate lossiness as the reference's fp16 param
         # broadcast (bf16 here: TPU-native, same 2-byte wire).
         self.update_wire_dtype = update_wire_dtype
+        # Flat fused update path (see optim.FusedAdamW): replicated
+        # layouts only — a flat vector can't express per-leaf shardings
+        self.fused = tx if isinstance(tx, FusedAdamW) else None
+        if self.fused is not None and (
+            self.policy.shard_grads
+            or self.policy.shard_params
+            or self.policy.shard_opt_state
+            or update_wire_dtype is not None
+        ):
+            raise ValueError(
+                "FusedAdamW requires a replicated (DDP) layout: ZeRO "
+                "policies and update_wire_dtype need per-leaf sharding — "
+                "use optim.adamw for those"
+            )
         if detect_anomaly:
             donate = False
 
@@ -167,52 +183,88 @@ class TrainStep:
                 state.params, state.model_state, batch, rng, state.scaler
             )
 
-        # fp16: unscale to f32 before clip/update (torch unscale_ parity)
         new_scaler = None
         finite = jnp.bool_(True)
-        if self.loss_scaler is not None and state.scaler is not None:
-            grads = self.loss_scaler.unscale_grads(grads, state.scaler)
-            finite = DynamicLossScaler.grads_finite(grads)
-            new_scaler = self.loss_scaler.update(state.scaler, finite)
+        gnorm_fused = None
+        if self.fused is not None:
+            # flat path: ravel once, scaler/clip/Adam as full-width vector
+            # ops, unravel once (see optim.FusedAdamW)
+            gflat = ravel_pytree(grads)[0].astype(jnp.float32)
+            if self.loss_scaler is not None and state.scaler is not None:
+                gflat = gflat * (
+                    1.0 / state.scaler.scale.astype(jnp.float32)
+                )
+                finite = jnp.all(jnp.isfinite(gflat))
+                new_scaler = self.loss_scaler.update(state.scaler, finite)
+            if self.detect_anomaly:
+                # NaN survives the (power-of-two) scale, so the tree-path
+                # check below reads identically on still-scaled grads
+                self._check_finite(
+                    grads, loss, nan_only=self.loss_scaler is not None
+                )
+            new_params, new_opt, gnorm_fused = self.fused.apply(
+                gflat,
+                state.opt_state,
+                state.params,
+                lr_factor,
+                gate=finite if self.loss_scaler is not None else None,
+            )
         else:
-            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            # fp16: unscale to f32 before clip/update (torch unscale_ parity)
+            if self.loss_scaler is not None and state.scaler is not None:
+                grads = self.loss_scaler.unscale_grads(grads, state.scaler)
+                finite = DynamicLossScaler.grads_finite(grads)
+                new_scaler = self.loss_scaler.update(state.scaler, finite)
+            else:
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
 
-        if self.detect_anomaly:
-            # after unscale; with a loss scaler active only NaN is anomalous
-            # (inf overflows are the scaler's own backoff-and-skip path —
-            # torch's set_detect_anomaly likewise flags NaN only)
-            self._check_finite(
-                grads, loss, nan_only=self.loss_scaler is not None
-            )
+            if self.detect_anomaly:
+                # after unscale; with a loss scaler active only NaN is
+                # anomalous (inf overflows are the scaler's own
+                # backoff-and-skip path — torch's set_detect_anomaly
+                # likewise flags NaN only)
+                self._check_finite(
+                    grads, loss, nan_only=self.loss_scaler is not None
+                )
 
-        # ZeRO-2/3: force reduce-scatter layout on grads
-        gspecs = self.policy.grads_specs(state.params, self.mesh)
-        if gspecs is not None:
-            grads = constrain(grads, gspecs, self.mesh)
+            # ZeRO-2/3: force reduce-scatter layout on grads
+            gspecs = self.policy.grads_specs(state.params, self.mesh)
+            if gspecs is not None:
+                grads = constrain(grads, gspecs, self.mesh)
 
-        updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
-        updates = jax.tree.map(lambda u: u * lr_factor, updates)  # plateau
-        if self.update_wire_dtype is not None:
-            # narrow the fan-out wire (see ctor comment); the add below
-            # upcasts back to the param dtype
-            updates = jax.tree.map(
-                lambda u: u.astype(self.update_wire_dtype), updates
+            updates, new_opt = self.tx.update(
+                grads, state.opt_state, state.params
             )
-        new_params = optax.apply_updates(state.params, updates)
+            updates = jax.tree.map(lambda u: u * lr_factor, updates)  # plateau
+            if self.update_wire_dtype is not None:
+                # narrow the fan-out wire (see ctor comment); the add below
+                # upcasts back to the param dtype
+                updates = jax.tree.map(
+                    lambda u: u.astype(self.update_wire_dtype), updates
+                )
+            new_params = optax.apply_updates(state.params, updates)
 
-        if self.loss_scaler is not None:
-            # skip the whole update on overflow (GradScaler semantics)
-            new_params = jax.tree.map(
-                lambda n, o: jnp.where(finite, n, o), new_params, state.params
-            )
-            new_opt = jax.tree.map(
-                lambda n, o: jnp.where(finite, n, o), new_opt, state.opt_state
-            )
+            if self.loss_scaler is not None:
+                # skip the whole update on overflow (GradScaler semantics)
+                new_params = jax.tree.map(
+                    lambda n, o: jnp.where(finite, n, o),
+                    new_params,
+                    state.params,
+                )
+                new_opt = jax.tree.map(
+                    lambda n, o: jnp.where(finite, n, o),
+                    new_opt,
+                    state.opt_state,
+                )
 
         new_model_state = aux.get("model_state", state.model_state)
         metrics = {"loss": loss.astype(jnp.float32)}
         if self.extra_metrics:
-            metrics["grad_norm"] = optax.global_norm(grads)
+            metrics["grad_norm"] = (
+                gnorm_fused
+                if gnorm_fused is not None
+                else optax.global_norm(grads)
+            )
             if new_scaler is not None:
                 metrics["loss_scale"] = new_scaler.scale
         for k, v in aux.items():
