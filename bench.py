@@ -430,12 +430,14 @@ def _bench() -> None:
             # fail fast with the named cause: a raw traceback would burn
             # every retry attempt on the same unreadable file
             raise SystemExit(f"bench_knobs.json unreadable: {e}")
-        unknown = set(knobs) - {"attn", "attn_pack", "norm", "softmax", "opt"}
+        unknown = set(knobs) - {
+            "attn", "attn_pack", "norm", "softmax", "opt", "loop",
+        }
         if unknown:
             # a typoed key would otherwise silently no-op the default flip
             raise SystemExit(
                 f"bench_knobs.json unknown keys {sorted(unknown)}; valid: "
-                "attn, attn_pack, norm, softmax, opt"
+                "attn, attn_pack, norm, softmax, opt, loop"
             )
 
     resolved = {}  # effective value + where it came from, for the log line
@@ -483,6 +485,12 @@ def _bench() -> None:
         # mirror the unknown-key guard: a typoed value must not benchmark
         # the chain arm under a non-chain label
         raise SystemExit(f"opt must be 'chain' or 'fused', got {opt_impl!r}")
+    # "scan" rolls the timed steps into one on-device lax.scan — separates
+    # the chip's step rate from this host's per-call dispatch cost (the
+    # 1-core VM can be the bottleneck at ~3 ms/step)
+    loop_impl = knob("GRAFT_BENCH_LOOP", "loop", "host")
+    if loop_impl not in ("host", "scan"):
+        raise SystemExit(f"loop must be 'host' or 'scan', got {loop_impl!r}")
     if any(src != "default" for _, src in resolved.values()):
         # the EFFECTIVE config (env > json > default), not the raw file —
         # result logs must attribute numbers to what actually ran
@@ -543,11 +551,31 @@ def _bench() -> None:
                     state, metrics = step(state, batch)
                 jax.block_until_ready(metrics["loss"])
         print("# child: warmup done, timing", flush=True)
-        t0 = time.perf_counter()
-        for _ in range(STEPS):
-            state, metrics = step(state, batch)
-        jax.block_until_ready(metrics["loss"])
-        dt = time.perf_counter() - t0
+        if loop_impl == "scan":
+            from functools import partial
+
+            import jax.lax as lax
+
+            @partial(jax.jit, donate_argnums=0)
+            def multi_step(s):
+                def body(s, _):
+                    s2, m = step._step(s, batch, jnp.float32(1.0))
+                    return s2, m["loss"]
+
+                return lax.scan(body, s, None, length=STEPS)
+
+            state, losses = multi_step(state)  # compile + warmup
+            jax.block_until_ready(losses)
+            t0 = time.perf_counter()
+            state, losses = multi_step(state)
+            jax.block_until_ready(losses)
+            dt = time.perf_counter() - t0
+        else:
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                state, metrics = step(state, batch)
+            jax.block_until_ready(metrics["loss"])
+            dt = time.perf_counter() - t0
 
     img_per_sec = BATCH * STEPS / dt
     print(

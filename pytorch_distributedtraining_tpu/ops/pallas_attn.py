@@ -31,6 +31,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _BIG_NEG = -1e30
+# Per-row stats (lse, delta) ride in [B, H, T, _STAT_LANES] instead of
+# [B, H, T]: Mosaic requires a block's last two dims divisible by (8, 128)
+# or equal to the array's — a (1, 1, bq) block of a rank-3 array violates
+# that on real TPUs (dim -2 is 1 != H). A broadcast 8-lane trailing dim
+# makes the block (bq, 8): bq%8==0 and 8==array dim, both legal, at 8x
+# the traffic of a [T] vector — noise next to the O(T*dh) tiles.
+_STAT_LANES = 8
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, causal, scale):
@@ -70,7 +77,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, causal, scale):
     acc, m, l = jax.lax.fori_loop(0, nk_run, body, (acc0, m0, l0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l_safe)  # per-row logsumexp of scaled logits
+    # per-row logsumexp of scaled logits, lane-broadcast (see _STAT_LANES)
+    lse_ref[0, 0] = jnp.broadcast_to(
+        (m + jnp.log(l_safe))[:, None], (bq, _STAT_LANES)
+    )
 
 
 def _dq_kernel(
@@ -80,8 +90,8 @@ def _dq_kernel(
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)  # [bq, dh]
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]  # [bq]
-    delta = delta_ref[0, 0]  # [bq]
+    lse = lse_ref[0, 0, :, 0]  # [bq] (lane-broadcast stats, col 0)
+    delta = delta_ref[0, 0, :, 0]  # [bq]
     t = k_ref.shape[2]
     nk = t // bk
 
@@ -129,8 +139,8 @@ def _dkv_kernel(
         dk, dv = carry
         q = q_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
         do = do_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * bq, bq)]
-        delta = delta_ref[0, 0, pl.ds(i * bq, bq)]
+        lse = lse_ref[0, 0, pl.ds(i * bq, bq), 0]
+        delta = delta_ref[0, 0, pl.ds(i * bq, bq), 0]
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -173,7 +183,7 @@ def _check_blocks(t, bq, bk):
 
 def _flash_forward(q, k, v, *, causal, bq, bk, interpret):
     """Returns (out, lse) in the caller's [B, T, H, Dh] layout for out and
-    [B, H, T] for lse."""
+    [B, H, T, _STAT_LANES] (lane-broadcast) for lse."""
     b, t, h, dh = q.shape
     bq, bk = min(bq, t), min(bk, t)
     _check_blocks(t, bq, bk)
@@ -193,11 +203,13 @@ def _flash_forward(q, k, v, *, causal, bq, bk, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, dh), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b_, h_, i: (b_, h_, i)),
+            pl.BlockSpec(
+                (1, 1, bq, _STAT_LANES), lambda b_, h_, i: (b_, h_, i, 0)
+            ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(qt.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, t), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, t, _STAT_LANES), jnp.float32),
         ],
         interpret=interpret,
     )(qt, kt, vt)
@@ -212,16 +224,24 @@ def _flash_backward(q, k, v, out, lse, do, *, causal, bq, bk, interpret):
     qt, kt, vt, ot, dot_ = (
         a.transpose(0, 2, 1, 3) for a in (q, k, v, out, do)
     )
-    # delta_i = dO_i . O_i — one elementwise pass, XLA fuses it
-    delta = jnp.sum(
-        dot_.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1
-    )  # [B, H, T]
+    # delta_i = dO_i . O_i — one elementwise pass, XLA fuses it; carried
+    # lane-broadcast like lse (see _STAT_LANES)
+    delta = jnp.broadcast_to(
+        jnp.sum(
+            dot_.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1
+        )[..., None],
+        (b, h, t, _STAT_LANES),
+    )
 
     tile_q = pl.BlockSpec((1, 1, bq, dh), lambda b_, h_, i: (b_, h_, i, 0))
     tile_k = pl.BlockSpec((1, 1, bk, dh), lambda b_, h_, i: (b_, h_, i, 0))
     full_seq = pl.BlockSpec((1, 1, t, dh), lambda b_, h_, i: (b_, h_, 0, 0))
-    row_q = pl.BlockSpec((1, 1, bq), lambda b_, h_, i: (b_, h_, i))
-    row_full = pl.BlockSpec((1, 1, t), lambda b_, h_, i: (b_, h_, 0))
+    row_q = pl.BlockSpec(
+        (1, 1, bq, _STAT_LANES), lambda b_, h_, i: (b_, h_, i, 0)
+    )
+    row_full = pl.BlockSpec(
+        (1, 1, t, _STAT_LANES), lambda b_, h_, i: (b_, h_, 0, 0)
+    )
 
     dq = pl.pallas_call(
         functools.partial(

@@ -92,6 +92,28 @@ def host_offload_supported(mesh: Mesh) -> bool:
 _HOST_OFFLOAD_SUPPORT: dict = {}
 
 
+def stream_to_device(tree, shardings):
+    """Inside-jit: copy pinned-host leaves into device memory.
+
+    Offloaded state (``Policy.offload_opt_state`` / ``offload_params``)
+    lives in pinned host memory between steps; TPU programs cannot mix
+    host- and device-placed operands in one op, so every program that
+    computes on possibly-offloaded trees streams them in first (an async
+    DMA XLA overlaps with compute). Device-resident leaves pass through
+    untouched; ``shardings=None`` is a no-op. The matching write-back is
+    the program's ``out_shardings``, which keep the host memory kind.
+    """
+    if shardings is None:
+        return tree
+
+    def one(x, s):
+        if getattr(s, "memory_kind", None) == "pinned_host":
+            return jax.device_put(x, s.with_memory_kind("device"))
+        return x
+
+    return jax.tree.map(one, tree, shardings)
+
+
 def constrain(tree, tree_of_specs, mesh: Mesh):
     """`with_sharding_constraint` applied leaf-wise (in-jit).
 

@@ -32,7 +32,7 @@ from ..optim import FusedAdamW
 from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
 from ..runtime.mesh import batch_spec
 from .policy import Policy
-from .spec import constrain
+from .spec import constrain, stream_to_device
 from .state import TrainState
 
 
@@ -117,6 +117,7 @@ class TrainStep:
         if detect_anomaly:
             donate = False
 
+        self._state_shardings = state_shardings
         data_sharding = NamedSharding(mesh, batch_spec(mesh))
         # pytree-prefix semantics: one sharding covers every batch leaf
         self._jitted = jax.jit(
@@ -145,6 +146,20 @@ class TrainStep:
         return loss, aux, grads
 
     def _step(self, state: TrainState, batch, lr_factor):
+        if self._state_shardings is not None and (
+            self.policy.offload_params or self.policy.offload_opt_state
+        ):
+            # offloaded leaves live in pinned host memory between steps;
+            # stream them in (async DMA), compute on device, and let
+            # out_shardings (which keep the host kind) write results back
+            state = state.replace(
+                params=stream_to_device(
+                    state.params, self._state_shardings.params
+                ),
+                opt_state=stream_to_device(
+                    state.opt_state, self._state_shardings.opt_state
+                ),
+            )
         rng = jax.random.fold_in(state.rng, state.step)
 
         if self.grad_accum_steps > 1:
@@ -370,9 +385,18 @@ class EvalStep:
                 data_sharding,
                 state_shardings.model_state,
             )
+            param_shardings = state_shardings.params
         else:
             in_shardings = (None, data_sharding, None)
-        self._jitted = jax.jit(eval_fn, in_shardings=in_shardings)
+            param_shardings = None
+
+        def run(params, batch, model_state):
+            # offloaded params stream in exactly like the train step
+            return eval_fn(
+                stream_to_device(params, param_shardings), batch, model_state
+            )
+
+        self._jitted = jax.jit(run, in_shardings=in_shardings)
 
     def __call__(self, state: TrainState, batch):
         with self.mesh:

@@ -35,7 +35,7 @@ from .. import optim as optim_mod
 from ..data import DataLoader as _DataLoader
 from ..ops import sync_scalar_device
 from ..parallel import TrainStep, create_train_state, policy_from_flags
-from ..parallel.spec import constrain, shard_axis
+from ..parallel.spec import constrain, shard_axis, stream_to_device
 from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
 from ..runtime import dist as _dist
 from ..runtime.mesh import MeshSpec, batch_spec, make_mesh
@@ -206,9 +206,7 @@ class _LazyLoss(_LazyBase):
 
     def materialize(self):
         if self._value is None:
-            self._value = self._facade._materialize_loss(
-                self._output, self._targets
-            )
+            self._value = self._facade._materialize_lazy_loss(self)
         return self._value
 
 
@@ -234,12 +232,20 @@ class Stoke:
         pretrained=None,
         mesh=None,
         rng_seed: int = 0,
+        fuse_eager_step: bool = True,
     ):
         _dist.initialize()
         self._module = model
         self._loss_callable = loss
         self.batch_size_per_device = int(batch_size_per_device)
         self.verbose = bool(verbose)
+        # fuse_eager_step: run the reference-shaped backward()+step() pair
+        # as ONE compiled program per accum window (backward defers, step
+        # dispatches). Measured on chip: the split loss_grad+apply pair is
+        # dispatch-bound at 0.59x of TrainStep; fusing restores the single-
+        # dispatch economics of the fast path while keeping eager API
+        # semantics (lazies resolve from the program's own outputs).
+        self.fuse_eager_step = bool(fuse_eager_step)
         self.grad_accum_steps = max(1, int(grad_accum_steps))
         self.grad_clip = grad_clip
         self._training = True
@@ -371,6 +377,9 @@ class Stoke:
         self._pending_lazies = []  # weakref.ref of unresolved handles
         self._backward_count = 0
         self._grad_acc = None
+        # deferred-backward records for the fused eager path:
+        # (inputs, targets, lazy_loss | None, lazy_output | None) per micro
+        self._pending_micro = []
         self._accepts_train = self._model_accepts("train")
 
         if sample_input is not None:
@@ -456,8 +465,11 @@ class Stoke:
     def _build_jits(self):
         precision = self.precision
         loss_callable = self._loss_callable
+        param_shardings = self._shardings.params
+        opt_shardings = self._shardings.opt_state
 
         def fwd(params, model_state, x, rng, train: bool):
+            params = stream_to_device(params, param_shardings)
             pc = precision.cast_to_compute(params)
             out, new_state = self._apply_model(pc, model_state, x, train, rng)
             return precision.cast_to_output(out), new_state
@@ -479,6 +491,10 @@ class Stoke:
             fwd_loss = jax.checkpoint(fwd_loss)
 
         def loss_grad(params, model_state, x, y, rng, scaler_state):
+            # stream BEFORE value_and_grad: differentiating through the
+            # host->device copy would transpose the grads back to host
+            params = stream_to_device(params, param_shardings)
+
             def lfn(p):
                 loss, out, new_state = fwd_loss(p, model_state, x, y, rng)
                 scaled = (
@@ -512,6 +528,8 @@ class Stoke:
         wire_dtype = self._update_wire_dtype()
 
         def apply_updates(params, opt_state, scaler_state, grads, lr):
+            params = stream_to_device(params, param_shardings)
+            opt_state = stream_to_device(opt_state, opt_shardings)
             finite = jnp.bool_(True)
             new_scaler = scaler_state
             if scaler is not None and scaler_state is not None:
@@ -548,6 +566,60 @@ class Stoke:
                 None,
             ),
             out_shardings=(
+                self._shardings.params,
+                self._shardings.opt_state,
+                self._shardings.scaler,
+            ),
+            donate_argnums=(0, 1),
+        )
+
+        # fused eager path: the whole accum window (every micro's fwd+bwd,
+        # the mean, and the update) as ONE program — the same two closures
+        # the split path jits (loss_grad / apply_updates), traced together
+        # so numerics are identical and the hot loop costs one dispatch.
+        # model_state threads micro-to-micro (sequential BN semantics,
+        # matching torch and the split eager path — TrainStep's scan
+        # broadcasts the pre-step state instead).
+        n_micro = self.grad_accum_steps
+
+        def eager_step(params, opt_state, scaler_state, model_state,
+                       micros, rng, lr):
+            gacc = None
+            losses, outs = [], []
+            ms = model_state
+            for x, y in micros:
+                loss, out, ms, grads = loss_grad(
+                    params, ms, x, y, rng, scaler_state
+                )
+                g32 = jax.tree.map(
+                    lambda g: g.astype(jnp.float32) / n_micro, grads
+                )
+                gacc = (
+                    g32 if gacc is None
+                    else jax.tree.map(jnp.add, gacc, g32)
+                )
+                losses.append(loss)
+                outs.append(out)
+            new_params, new_opt, new_scaler = apply_updates(
+                params, opt_state, scaler_state, gacc, lr
+            )
+            return losses, outs, ms, new_params, new_opt, new_scaler
+
+        self._jit_eager_step = jax.jit(
+            eager_step,
+            in_shardings=(
+                self._shardings.params,
+                self._shardings.opt_state,
+                self._shardings.scaler,
+                self._shardings.model_state,
+                None,
+                None,
+                None,
+            ),
+            out_shardings=(
+                None,
+                None,
+                self._shardings.model_state,
                 self._shardings.params,
                 self._shardings.opt_state,
                 self._shardings.scaler,
@@ -593,6 +665,20 @@ class Stoke:
         self._note_loss(loss)
         return loss
 
+    def _materialize_lazy_loss(self, lazy):
+        """Early use of a deferred loss.
+
+        If the handle belongs to a pending (deferred-backward) micro, the
+        grads for its window are needed anyway — flush the window through
+        the split path, which computes and records this loss as a
+        byproduct (no throwaway forward; `step()` then takes the legacy
+        apply). Otherwise (pre-backward use) run the standalone
+        forward+loss programs."""
+        if any(rec[2] is lazy for rec in self._pending_micro):
+            self._flush_pending_micros()
+            return lazy._value
+        return self._materialize_loss(lazy._output, lazy._targets)
+
     def loss(self, outputs, targets):
         """Loss computation (`Stoke-DDP.py:74,118`). Deferred when the
         outputs are themselves deferred — ``.backward()`` then resolves it
@@ -610,20 +696,51 @@ class Stoke:
         return loss
 
     def backward(self, loss=None):
-        """Backward (`Stoke-DDP.py:79`): recomputes fwd+loss under grad on
-        the recorded (inputs, targets) pair and accumulates ``grads/accum``.
-        The ``loss`` argument is accepted for API parity; gradients come
-        from the compiled loss_grad program."""
+        """Backward (`Stoke-DDP.py:79`).
+
+        With ``fuse_eager_step`` (default) this *defers*: the micro's
+        (inputs, targets) are recorded and the whole accum window runs as
+        one compiled fwd+bwd+update program inside ``.step()`` — the
+        reference loop then costs a single dispatch per window, same as
+        the fused fast path. The deferred loss/output handles resolve
+        from that program's outputs; used before ``.step()`` they
+        self-materialize, so deferral never changes observable values.
+
+        The split path (``fuse_eager_step=False`` or odd call patterns)
+        recomputes fwd+loss under grad on the recorded pair right here
+        and accumulates ``grads/accum``. The ``loss`` argument is
+        accepted for API parity; gradients come from the compiled
+        programs either way."""
         if self._last_inputs is None or self._last_targets is None:
             raise RuntimeError(
                 "backward() needs a preceding model(inputs) and loss(outputs, targets)"
             )
+        lazy_loss = loss if isinstance(loss, _LazyLoss) else self._lazy_loss
+        lazy_out = self._lazy_output
+        self._lazy_loss = None
+        self._lazy_output = None
+        if self.fuse_eager_step:
+            self._pending_micro.append(
+                (self._last_inputs, self._last_targets, lazy_loss, lazy_out)
+            )
+            self._backward_count += 1
+            # split-path parity: a caller that brought its own concrete
+            # loss gets it back, not None
+            return lazy_loss if lazy_loss is not None else loss
+        val = self._backward_now(
+            self._last_inputs, self._last_targets, lazy_loss, lazy_out
+        )
+        self._backward_count += 1
+        return val
+
+    def _backward_now(self, x, y, lazy_loss=None, lazy_out=None):
+        """Split-path backward on one micro (does NOT bump the counter)."""
         rng = jax.random.fold_in(self._state.rng, self._state.step)
         loss_val, out, new_model_state, grads = self._jit_loss_grad(
             self._state.params,
             self._state.model_state,
-            self._last_inputs,
-            self._last_targets,
+            x,
+            y,
             rng,
             self._state.scaler,
         )
@@ -636,24 +753,29 @@ class Stoke:
                 if self._grad_acc is None
                 else self._jit_acc(self._grad_acc, grads)
             )
-        self._backward_count += 1
         self._note_loss(loss_val)
         # resolve the deferred loss/output handles from the fused program's
         # own results, so `detach_and_sync_loss(loss)` and any later use of
         # the `.model()` output cost nothing extra
-        if isinstance(loss, _LazyLoss):
-            loss._value = loss_val
-        if self._lazy_loss is not None:
-            self._lazy_loss._value = loss_val
-            self._lazy_loss = None
-        if self._lazy_output is not None:
-            self._lazy_output._value = out
-            self._lazy_output = None
+        if lazy_loss is not None:
+            lazy_loss._value = loss_val
+        if lazy_out is not None and lazy_out._value is None:
+            lazy_out._value = out
+        self._prune_pending_lazies()
+        return loss_val
+
+    def _prune_pending_lazies(self):
         self._pending_lazies = [
             r for r in self._pending_lazies
             if r() is not None and r()._value is None
         ]
-        return loss_val
+
+    def _flush_pending_micros(self):
+        """Run deferred micros through the split path (odd call patterns:
+        mixed accumulation state, early prints — correctness over speed)."""
+        window, self._pending_micro = self._pending_micro, []
+        for x, y, lazy_loss, lazy_out in window:
+            self._backward_now(x, y, lazy_loss, lazy_out)
 
     def step(self):
         """Optimizer step (`Stoke-DDP.py:82`): fires every
@@ -662,6 +784,13 @@ class Stoke:
             return
         if self._backward_count % self.grad_accum_steps != 0:
             return
+        if (
+            self._pending_micro
+            and self._grad_acc is None
+            and len(self._pending_micro) == self.grad_accum_steps
+        ):
+            return self._step_fused()
+        self._flush_pending_micros()
         # any still-deferred handles hold references to the CURRENT params,
         # whose buffers _jit_apply is about to donate — materialize them now
         # so late use reproduces the pre-step forward instead of crashing
@@ -686,10 +815,66 @@ class Stoke:
         self._grad_acc = None
         self._backward_count = 0
 
-    def zero_grad(self):
-        """Drop accumulated grads (raw-loop parity, `Fairscale-DDP.py:97`)."""
+    def _step_fused(self):
+        """The deferred accum window as one compiled program."""
+        window, self._pending_micro = self._pending_micro, []
+        # handles from OUTSIDE this window still reference the pre-step
+        # params whose buffers the program donates — materialize them now;
+        # the window's own handles resolve from the program outputs below
+        window_ids = {
+            id(h) for rec in window for h in rec[2:] if h is not None
+        }
+        for ref in self._pending_lazies:
+            lazy = ref()
+            if (
+                lazy is not None
+                and lazy._value is None
+                and id(lazy) not in window_ids
+            ):
+                lazy.materialize()
+        self._pending_lazies = []
+        rng = jax.random.fold_in(self._state.rng, self._state.step)
+        micros = tuple((x, y) for x, y, _, _ in window)
+        losses, outs, new_ms, new_params, new_opt, new_scaler = (
+            self._jit_eager_step(
+                self._state.params,
+                self._state.opt_state,
+                self._state.scaler,
+                self._state.model_state,
+                micros,
+                rng,
+                jnp.float32(self._opt_handle.lr),
+            )
+        )
+        for (_, _, lazy_loss, lazy_out), loss_val, out in zip(
+            window, losses, outs
+        ):
+            self._note_loss(loss_val)
+            # `is None` guards: a handle the user force-materialized
+            # mid-window keeps its observed value (the fused program's
+            # differently-fused result could round differently)
+            if lazy_loss is not None and lazy_loss._value is None:
+                lazy_loss._value = loss_val
+            if lazy_out is not None and lazy_out._value is None:
+                lazy_out._value = out
+        self._state = self._state.replace(
+            params=new_params,
+            opt_state=new_opt,
+            scaler=new_scaler,
+            model_state=new_ms,
+            step=self._state.step + 1,
+        )
         self._grad_acc = None
         self._backward_count = 0
+
+    def zero_grad(self):
+        """Drop accumulated grads (raw-loop parity, `Fairscale-DDP.py:97`).
+
+        Deferred micros are dropped too; their handles self-materialize
+        (captured params) if still referenced."""
+        self._grad_acc = None
+        self._backward_count = 0
+        self._pending_micro = []
 
     def detach_and_sync_loss(self, loss):
         """Cross-device mean of a loss for reporting (`Stoke-DDP.py:86`).
