@@ -57,9 +57,15 @@ def main() -> None:
     model = GPT2(cfg, decode=True)
     train_model = GPT2(cfg, decode=False)
     rng = np.random.default_rng(0)
-    prompt = jnp.asarray(
-        rng.integers(0, cfg.vocab_size, (BATCH, PROMPT)), jnp.int32
+    # one prompt per rep PLUS a warmup-only prompt: the tunnel memoizes
+    # identical (program, args) executions (BASELINE.md round-4 — the
+    # 6.6M tok/s artifact), so every timed call must decode inputs the
+    # tunnel has never seen — including rep 0 vs the warmup call
+    prompts = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (REPS + 1, BATCH, PROMPT)),
+        jnp.int32,
     )
+    prompt = prompts[REPS]  # warmup-only
     params = train_model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, PROMPT), jnp.int32)
     )["params"]
@@ -76,8 +82,8 @@ def main() -> None:
         out = run(params, prompt)  # compile + warm
         jax.block_until_ready(out)
         t0 = time.perf_counter()
-        for _ in range(REPS):
-            out = run(params, prompt)
+        for i in range(REPS):
+            out = run(params, prompts[i])
         jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / REPS
         assert out.shape == (BATCH, PROMPT + NEW), out.shape

@@ -22,7 +22,20 @@ import time
 import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 STAGES = [
-    ("bench", "headline SwinIR-S x2 train step (bench.py, default knobs)"),
+    ("bench", "headline SwinIR-S x2 train step (bench.py, committed knobs)"),
+    ("bench_s200", "bench.py, committed knobs, STEPS=200 sustained"),
+    ("bench_chain", "bench.py, per-leaf optax chain, STEPS=200"),
+    ("bench_fused_bf16ln", "bench.py, fused opt + bf16 LayerNorms, STEPS=200"),
+    ("bench_fused_combo", "bench.py, fused + pallas + pack + bf16 norms, STEPS=200"),
+    ("bench_fused_paired", "bench.py, fused + paired attention, STEPS=200"),
+    ("bench_scan", "bench.py, fused + on-device lax.scan loop, STEPS=200"),
+    ("bench_b36_fused", "bench.py, fused, batch 36 (occupancy), STEPS=200"),
+    ("facade", "facade vs TrainStep (facade_bench.py)"),
+    ("offload", "optimizer/param host offload (offload_smoke.py)"),
+    ("attn", "flash attention vs XLA (attn_bench.py)"),
+    ("ladder4", "ladder config 4 GPT-2 FSDP retry (ladder.py)"),
+    ("profile", "ablation profiler (profile_swinir.py)"),
+    # legacy round-3 arm names, kept so old result dirs still render
     ("bench_pallas", "bench.py, GRAFT_BENCH_ATTN=pallas"),
     ("bench_packed", "bench.py, pallas + attn_pack=2"),
     ("bench_paired", "bench.py, GRAFT_BENCH_ATTN=paired (128-row tiles)"),
@@ -32,10 +45,6 @@ STAGES = [
     ("bench_combo_paired", "bench.py, paired + bf16 norms"),
     ("bench_b36", "bench.py, batch 36 (occupancy probe)"),
     ("bench_trace", "bench.py with op-trace capture"),
-    ("profile", "ablation profiler (profile_swinir.py)"),
-    ("facade", "facade vs TrainStep (facade_bench.py)"),
-    ("attn", "flash attention vs XLA (attn_bench.py)"),
-    ("offload", "optimizer/param host offload (offload_smoke.py)"),
     ("decode", "GPT-2 decode throughput (decode_bench.py)"),
     ("ladder", "five-config ladder (ladder.py --all)"),
 ]
@@ -43,14 +52,17 @@ STAGES = [
 # bench.py env knobs behind each A/B arm — rendered with the winner so
 # the default-flip decision is mechanical when the window opens unattended
 ARM_KNOBS = {
-    "bench": "(defaults)",
-    "bench_pallas": "GRAFT_BENCH_ATTN=pallas",
-    "bench_packed": "GRAFT_BENCH_ATTN=pallas GRAFT_BENCH_ATTN_PACK=2",
-    "bench_paired": "GRAFT_BENCH_ATTN=paired",
-    "bench_blockdiag": "GRAFT_BENCH_ATTN=blockdiag",
-    "bench_bf16ln": "GRAFT_BENCH_NORM=bf16",
-    "bench_combo": "GRAFT_BENCH_ATTN=pallas GRAFT_BENCH_ATTN_PACK=2 GRAFT_BENCH_NORM=bf16",
-    "bench_combo_paired": "GRAFT_BENCH_ATTN=paired GRAFT_BENCH_NORM=bf16",
+    # STEPS=200 sustained arms (round-4 methodology) — only these are
+    # comparable to each other; the winner line is drawn from them
+    "bench_s200": "(committed bench_knobs.json)",
+    "bench_chain": "GRAFT_BENCH_OPT=chain",
+    "bench_fused_bf16ln": "GRAFT_BENCH_OPT=fused GRAFT_BENCH_NORM=bf16",
+    "bench_fused_combo": (
+        "GRAFT_BENCH_OPT=fused GRAFT_BENCH_ATTN=pallas "
+        "GRAFT_BENCH_ATTN_PACK=2 GRAFT_BENCH_NORM=bf16"
+    ),
+    "bench_fused_paired": "GRAFT_BENCH_OPT=fused GRAFT_BENCH_ATTN=paired",
+    "bench_scan": "GRAFT_BENCH_OPT=fused GRAFT_BENCH_LOOP=scan",
 }
 
 
@@ -100,18 +112,22 @@ def render(results_dir: str) -> str:
     # flip mechanical even when the pool window opened unattended
     if len(arms) > 1:  # a lone arm has nothing to win against
         best = max(arms, key=arms.get)
-        base = arms.get("bench")
-        gain = f" ({arms[best] / base - 1:+.1%} vs defaults)" if base else ""
+        base = arms.get("bench_s200")
+        gain = (
+            f" ({arms[best] / base - 1:+.1%} vs committed knobs)"
+            if base
+            else ""
+        )
         line = (
             f"- **A/B winner**: `{best}` at {arms[best]} img/s{gain} — "
             f"knobs: `{ARM_KNOBS[best]}`."
         )
-        if best != "bench":
+        if best != "bench_s200":
             line += (
-                " To make this the default, commit the matching knobs as "
+                " To make this the default, fold the matching knobs into "
                 "`bench_knobs.json` at the repo root (env > json > "
-                "built-in; keys attn/attn_pack/norm/softmax) — and the "
-                "SwinIR defaults if quality tolerances hold."
+                "built-in; keys attn/attn_pack/norm/softmax/opt/loop) — "
+                "and the SwinIR defaults if quality tolerances hold."
             )
         out += ["", line]
     out.append("")

@@ -106,14 +106,26 @@ def time_step(mesh, state, step, batch):
         return (time.perf_counter() - t0) / STEPS
 
 
-def time_fn(fn, *args):
+def time_fn(fn, params, batch):
+    # vary the batch per rep INSIDE one jitted program: the tunnel
+    # memoizes identical (program, args) executions, which produced the
+    # round-4 "impossible throughput" variant numbers (fwd at 790 TF/s).
+    # A distinct epsilon per rep keeps every call real work at one
+    # dispatch per rep; time_step needs no such treatment because the
+    # threaded TrainState differs every step.
+    wrapped = jax.jit(
+        lambda e, p, b: fn(p, jax.tree.map(lambda x: x + e, b))
+    )
+    eps = [
+        jax.device_put(jnp.float32((i + 1) * 1e-6)) for i in range(STEPS)
+    ]
     out = None
     for _ in range(WARMUP):
-        out = fn(*args)
+        out = wrapped(jnp.float32(0), params, batch)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
-    for _ in range(STEPS):
-        out = fn(*args)
+    for i in range(STEPS):
+        out = wrapped(eps[i], params, batch)
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / STEPS
 

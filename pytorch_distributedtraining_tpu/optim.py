@@ -184,6 +184,35 @@ class FusedAdamW:
             gnorm,
         )
 
+    def apply_tree(
+        self,
+        grads,
+        opt_state,
+        params,
+        lr_factor=1.0,
+        scaler=None,
+        scaler_state=None,
+    ):
+        """One update from a grads PYTREE, with optional GradScaler.
+
+        The shared fused hot path of ``TrainStep`` and the Stoke facade:
+        ravel once, flat unscale + finite gate (overflow skips the whole
+        update), then :meth:`apply`. Returns ``(new_params,
+        new_opt_state, new_scaler_state, grad_norm)`` — ``new_scaler_state``
+        is ``scaler_state`` unchanged when no scaler is active.
+        """
+        gflat = ravel_pytree(grads)[0].astype(jnp.float32)
+        new_scaler = scaler_state
+        gate = None
+        if scaler is not None and scaler_state is not None:
+            gflat = gflat * (1.0 / scaler_state.scale.astype(jnp.float32))
+            gate = jnp.all(jnp.isfinite(gflat))
+            new_scaler = scaler.update(scaler_state, gate)
+        new_params, new_opt, gnorm = self.apply(
+            gflat, opt_state, params, lr_factor, gate=gate
+        )
+        return new_params, new_opt, new_scaler, gnorm
+
 
 OPTIMIZERS = {"adamw": adamw, "sgd": sgd}
 

@@ -25,7 +25,6 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import optax
-from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding
 
 from ..optim import FusedAdamW
@@ -203,26 +202,25 @@ class TrainStep:
         gnorm_fused = None
         if self.fused is not None:
             # flat path: ravel once, scaler/clip/Adam as full-width vector
-            # ops, unravel once (see optim.FusedAdamW)
-            gflat = ravel_pytree(grads)[0].astype(jnp.float32)
-            if self.loss_scaler is not None and state.scaler is not None:
-                gflat = gflat * (
-                    1.0 / state.scaler.scale.astype(jnp.float32)
-                )
-                finite = jnp.all(jnp.isfinite(gflat))
-                new_scaler = self.loss_scaler.update(state.scaler, finite)
+            # ops, unravel once (see optim.FusedAdamW.apply_tree)
             if self.detect_anomaly:
                 # NaN survives the (power-of-two) scale, so the tree-path
                 # check below reads identically on still-scaled grads
                 self._check_finite(
                     grads, loss, nan_only=self.loss_scaler is not None
                 )
-            new_params, new_opt, gnorm_fused = self.fused.apply(
-                gflat,
-                state.opt_state,
-                state.params,
-                lr_factor,
-                gate=finite if self.loss_scaler is not None else None,
+            scaler_state = (
+                state.scaler if self.loss_scaler is not None else None
+            )
+            new_params, new_opt, new_scaler, gnorm_fused = (
+                self.fused.apply_tree(
+                    grads,
+                    state.opt_state,
+                    state.params,
+                    lr_factor,
+                    scaler=self.loss_scaler,
+                    scaler_state=scaler_state,
+                )
             )
         else:
             # fp16: unscale to f32 before clip/update (torch unscale_ parity)

@@ -233,6 +233,7 @@ class Stoke:
         mesh=None,
         rng_seed: int = 0,
         fuse_eager_step: bool = True,
+        fused_optimizer: bool | None = None,
     ):
         _dist.initialize()
         self._module = model
@@ -358,8 +359,29 @@ class Stoke:
             # grad_clip argument takes precedence
             kwargs.setdefault("clip_grad_norm", ds_config.gradient_clipping)
         # lr=1.0: the real lr rides the OptimizerHandle and is applied as a
-        # runtime scalar, so torch-style schedulers never retrace anything
-        self._tx = factory(lr=1.0, **kwargs)
+        # runtime scalar, so torch-style schedulers never retrace anything.
+        # fused_optimizer=None (auto): replicated-layout AdamW takes the
+        # flat fused update — the measured 2.6x step-time winner on chip
+        # (BASELINE.md round-4); numerics are pinned to the per-leaf chain
+        # by tests/test_fused_optim.py. Sharded (ZeRO/OSS) layouts need
+        # per-leaf shardings and keep the optax chain. Pass
+        # fused_optimizer=False to keep the chain layout — e.g. to
+        # .load() a checkpoint whose opt_state was saved pre-fused (the
+        # two opt_state pytrees are not interchangeable).
+        fused_eligible = factory is optim_mod.adamw and not (
+            self.policy.shard_params
+            or self.policy.shard_grads
+            or self.policy.shard_opt_state
+        )
+        if fused_optimizer is True and not fused_eligible:
+            raise ValueError(
+                "fused_optimizer=True needs AdamW on a replicated (DDP) "
+                "layout; sharded policies keep the per-leaf chain"
+            )
+        if fused_eligible and fused_optimizer is not False:
+            self._tx = optim_mod.FusedAdamW(lr=1.0, **kwargs)
+        else:
+            self._tx = factory(lr=1.0, **kwargs)
         self._opt_handle = optim_mod.OptimizerHandle(self._base_lr)
 
         # -- lazy-built state ---------------------------------------------
@@ -527,9 +549,23 @@ class Stoke:
 
         wire_dtype = self._update_wire_dtype()
 
+        fused_tx = tx if isinstance(tx, optim_mod.FusedAdamW) else None
+
         def apply_updates(params, opt_state, scaler_state, grads, lr):
             params = stream_to_device(params, param_shardings)
             opt_state = stream_to_device(opt_state, opt_shardings)
+            if fused_tx is not None:
+                # flat fused path: one ravel, full-width unscale/gate/
+                # update — shared with TrainStep via FusedAdamW.apply_tree
+                new_params, new_opt, new_scaler, _ = fused_tx.apply_tree(
+                    grads,
+                    opt_state,
+                    params,
+                    lr,
+                    scaler=scaler,
+                    scaler_state=scaler_state,
+                )
+                return new_params, new_opt, new_scaler
             finite = jnp.bool_(True)
             new_scaler = scaler_state
             if scaler is not None and scaler_state is not None:
@@ -580,8 +616,6 @@ class Stoke:
         # model_state threads micro-to-micro (sequential BN semantics,
         # matching torch and the split eager path — TrainStep's scan
         # broadcasts the pre-step state instead).
-        n_micro = self.grad_accum_steps
-
         def eager_step(params, opt_state, scaler_state, model_state,
                        micros, rng, lr):
             gacc = None
@@ -591,13 +625,7 @@ class Stoke:
                 loss, out, ms, grads = loss_grad(
                     params, ms, x, y, rng, scaler_state
                 )
-                g32 = jax.tree.map(
-                    lambda g: g.astype(jnp.float32) / n_micro, grads
-                )
-                gacc = (
-                    g32 if gacc is None
-                    else jax.tree.map(jnp.add, gacc, g32)
-                )
+                gacc = acc(gacc, grads)  # the split path's own fold
                 losses.append(loss)
                 outs.append(out)
             new_params, new_opt, new_scaler = apply_updates(
@@ -756,8 +784,10 @@ class Stoke:
         self._note_loss(loss_val)
         # resolve the deferred loss/output handles from the fused program's
         # own results, so `detach_and_sync_loss(loss)` and any later use of
-        # the `.model()` output cost nothing extra
-        if lazy_loss is not None:
+        # the `.model()` output cost nothing extra; `is None` guards keep
+        # any already-observed value stable (differently-fused programs
+        # can round differently)
+        if lazy_loss is not None and lazy_loss._value is None:
             lazy_loss._value = loss_val
         if lazy_out is not None and lazy_out._value is None:
             lazy_out._value = out
