@@ -71,8 +71,11 @@ def main() -> None:
     lr_img = hr.reshape(BATCH, PATCH, 2, PATCH, 2, 3).mean(axis=(2, 4))
 
     # -- path A: raw TrainStep (the bench.py configuration) ---------------
+    # FusedAdamW to match what the facade auto-selects on replicated
+    # AdamW: the ratio isolates the eager surface's overhead, so both
+    # paths must run the same optimizer economics
     mesh = make_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
-    tx = optim.adamw(lr=5e-4, clip_grad_norm=0.1)
+    tx = optim.FusedAdamW(lr=5e-4, clip_grad_norm=0.1)
 
     def loss_fn(params, batch, rng_, model_state):
         x, y = batch

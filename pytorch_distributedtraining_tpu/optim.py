@@ -101,8 +101,17 @@ class FusedAdamW:
     reduction order of the global norm differs (single flat sum vs
     per-leaf partials).
 
-    Replicated (DDP) layouts only: a flat vector has no per-leaf sharding
-    story, so ``TrainStep`` rejects it under ZeRO/FSDP policies.
+    Layouts: replicated (DDP) params/grads, with optionally **sharded
+    flat moments** (ZeRO-1/OSS): the [N] ``mu``/``nu`` vectors shard
+    cleanly over the data axis (``Policy.opt_specs`` does it through the
+    ordinary ``leaf_spec`` path), GSPMD computes the update shard-wise
+    and all-gathers the flat update once — DeepSpeed's flat-partitioned
+    optimizer expressed as shardings. Per-leaf grad/param sharding
+    (ZeRO-2/3) has no flat story; ``TrainStep`` rejects those.
+
+    ``update_wire_dtype`` narrows the all-gathered update vector (the
+    fairscale OSS ``broadcast_fp16`` twin) — one cast on the flat vector
+    instead of one per leaf.
 
     ``lr`` may be a float or a schedule ``f(count) -> lr`` evaluated
     inside the compiled step.
@@ -116,6 +125,7 @@ class FusedAdamW:
         weight_decay: float = 0.01,
         clip_grad_norm: float | None = None,
         clip_grad_value: float | None = None,
+        update_wire_dtype=None,
     ):
         self.lr = lr
         self.b1, self.b2 = betas
@@ -123,13 +133,23 @@ class FusedAdamW:
         self.weight_decay = weight_decay
         self.clip_grad_norm = clip_grad_norm
         self.clip_grad_value = clip_grad_value
+        self.update_wire_dtype = update_wire_dtype
+
+    # flat buffers pad to a multiple of 1024 so a ZeRO-1 mesh axis (any
+    # power of two <= 1024) divides them — DeepSpeed pads its flat
+    # partitions for the same reason. Pad lanes carry zeros throughout:
+    # zero grad -> zero moments -> zero update. TrainStep warns when a
+    # sharded-opt policy still degenerates to replicated (e.g. an axis
+    # that does not divide the padded length).
+    _PAD = 1024
 
     def init(self, params) -> FusedAdamWState:
         n = sum(x.size for x in jax.tree.leaves(params))
+        n_pad = -(-n // self._PAD) * self._PAD
         return FusedAdamWState(
             count=jnp.zeros([], jnp.int32),
-            mu=jnp.zeros((n,), jnp.float32),
-            nu=jnp.zeros((n,), jnp.float32),
+            mu=jnp.zeros((n_pad,), jnp.float32),
+            nu=jnp.zeros((n_pad,), jnp.float32),
         )
 
     def apply(
@@ -149,8 +169,9 @@ class FusedAdamW:
         on flat buffers instead of one per leaf.
         """
         pflat, unravel = ravel_pytree(params)
-        p32 = pflat.astype(jnp.float32)
-        g = gflat
+        pad = opt_state.mu.size - pflat.size
+        p32 = jnp.pad(pflat.astype(jnp.float32), (0, pad))
+        g = jnp.pad(gflat, (0, pad))
         gnorm = jnp.sqrt(jnp.sum(g * g))  # pre-clip, the metric's contract
         if self.clip_grad_norm is not None:
             c = jnp.float32(self.clip_grad_norm)
@@ -172,14 +193,19 @@ class FusedAdamW:
         upd = mu_hat / (jnp.sqrt(nu_hat) + self.eps)
         if self.weight_decay:
             upd = upd + self.weight_decay * p32
-        new_p32 = p32 - lr_t * upd
+        step_vec = -lr_t * upd
+        if self.update_wire_dtype is not None:
+            # narrow the (possibly all-gathered) update fan-out wire; the
+            # add below upcasts back — OSS broadcast_fp16 semantics
+            step_vec = step_vec.astype(self.update_wire_dtype)
+        new_p32 = p32 + step_vec.astype(jnp.float32)
         if gate is not None:
             new_p32 = jnp.where(gate, new_p32, p32)
             mu = jnp.where(gate, mu, opt_state.mu)
             nu = jnp.where(gate, nu, opt_state.nu)
             count = jnp.where(gate, count, opt_state.count)
         return (
-            unravel(new_p32.astype(pflat.dtype)),
+            unravel(new_p32[: pflat.size].astype(pflat.dtype)),
             FusedAdamWState(count=count, mu=mu, nu=nu),
             gnorm,
         )

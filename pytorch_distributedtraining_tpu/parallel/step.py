@@ -25,7 +25,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..optim import FusedAdamW
 from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
@@ -99,24 +99,52 @@ class TrainStep:
         # the same deliberate lossiness as the reference's fp16 param
         # broadcast (bf16 here: TPU-native, same 2-byte wire).
         self.update_wire_dtype = update_wire_dtype
-        # Flat fused update path (see optim.FusedAdamW): replicated
-        # layouts only — a flat vector can't express per-leaf shardings
+        # Flat fused update path (see optim.FusedAdamW). Composes with
+        # ZeRO-1 (the flat [N] moments shard over the data axis through
+        # the ordinary opt_specs path; GSPMD all-gathers the flat update
+        # once). Per-leaf grad/param sharding (ZeRO-2/3) has no flat
+        # story, and the per-leaf wire cast belongs to the tree path —
+        # FusedAdamW carries its own update_wire_dtype.
         self.fused = tx if isinstance(tx, FusedAdamW) else None
         if self.fused is not None and (
             self.policy.shard_grads
             or self.policy.shard_params
-            or self.policy.shard_opt_state
             or update_wire_dtype is not None
         ):
             raise ValueError(
-                "FusedAdamW requires a replicated (DDP) layout: ZeRO "
-                "policies and update_wire_dtype need per-leaf sharding — "
-                "use optim.adamw for those"
+                "FusedAdamW composes with replicated (DDP) and ZeRO-1 "
+                "layouts only: ZeRO-2/3 shard grads/params per leaf, and "
+                "update_wire_dtype is the tree path's knob (pass "
+                "FusedAdamW(update_wire_dtype=...) instead) — use "
+                "optim.adamw for those"
             )
         if detect_anomaly:
             donate = False
 
         self._state_shardings = state_shardings
+        if (
+            self.fused is not None
+            and self.policy.shard_opt_state
+            and state_shardings is not None
+            and all(
+                getattr(s, "spec", None) == PartitionSpec()
+                for s in jax.tree.leaves(state_shardings.opt_state)
+                if hasattr(s, "spec")
+            )
+        ):
+            # the ZeRO-1 memory saving the user asked for silently never
+            # materializes when the axis doesn't divide the padded flat
+            # length (FusedAdamW._PAD) — say so instead of training on
+            import warnings
+
+            warnings.warn(
+                "FusedAdamW under a sharded-opt-state policy, but the "
+                "flat moments resolved to fully replicated (mesh axis "
+                "does not divide the padded length?) — the ZeRO-1 memory "
+                "saving is not in effect",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         data_sharding = NamedSharding(mesh, batch_spec(mesh))
         # pytree-prefix semantics: one sharding covers every batch leaf
         self._jitted = jax.jit(

@@ -360,23 +360,24 @@ class Stoke:
             kwargs.setdefault("clip_grad_norm", ds_config.gradient_clipping)
         # lr=1.0: the real lr rides the OptimizerHandle and is applied as a
         # runtime scalar, so torch-style schedulers never retrace anything.
-        # fused_optimizer=None (auto): replicated-layout AdamW takes the
-        # flat fused update — the measured 2.6x step-time winner on chip
-        # (BASELINE.md round-4); numerics are pinned to the per-leaf chain
-        # by tests/test_fused_optim.py. Sharded (ZeRO/OSS) layouts need
-        # per-leaf shardings and keep the optax chain. Pass
-        # fused_optimizer=False to keep the chain layout — e.g. to
-        # .load() a checkpoint whose opt_state was saved pre-fused (the
-        # two opt_state pytrees are not interchangeable).
+        # fused_optimizer=None (auto): replicated (DDP) and ZeRO-1/OSS
+        # AdamW layouts take the flat fused update — the measured 2.6x
+        # step-time winner on chip (BASELINE.md round-4); under ZeRO-1
+        # the flat moments shard over dp (DeepSpeed flat partitioning as
+        # shardings). Numerics are pinned to the per-leaf chain by
+        # tests/test_fused_optim.py. ZeRO-2/3 shard grads/params per
+        # leaf and keep the optax chain. Pass fused_optimizer=False to
+        # keep the chain layout — e.g. to .load() a checkpoint whose
+        # opt_state was saved pre-fused (the pytrees are not
+        # interchangeable).
         fused_eligible = factory is optim_mod.adamw and not (
-            self.policy.shard_params
-            or self.policy.shard_grads
-            or self.policy.shard_opt_state
+            self.policy.shard_params or self.policy.shard_grads
         )
         if fused_optimizer is True and not fused_eligible:
             raise ValueError(
                 "fused_optimizer=True needs AdamW on a replicated (DDP) "
-                "layout; sharded policies keep the per-leaf chain"
+                "or ZeRO-1/OSS layout; ZeRO-2/3 shard grads/params per "
+                "leaf and keep the per-leaf chain"
             )
         if fused_eligible and fused_optimizer is not False:
             self._tx = optim_mod.FusedAdamW(lr=1.0, **kwargs)
@@ -431,6 +432,10 @@ class Stoke:
             lambda x: jnp.asarray(x)[:1] if hasattr(x, "shape") else x, sample_input
         )
         init_kwargs = {"train": False} if self._accepts_train else {}
+        if isinstance(self._tx, optim_mod.FusedAdamW):
+            # the OSS broadcast_fp16 wire needs the mesh (ctor doesn't
+            # have it): resolve onto the tx before anything traces
+            self._tx.update_wire_dtype = self._update_wire_dtype()
         self._state, self._shardings = create_train_state(
             model=self._module,
             sample_input=sample,
@@ -947,7 +952,13 @@ class Stoke:
                 loss_scaler=self.loss_scaler,
                 state_shardings=self._shardings,
                 donate=self.tpu_config.donate_state,
-                update_wire_dtype=self._update_wire_dtype(),
+                # a FusedAdamW carries its own flat wire dtype (set at
+                # init()); the per-leaf knob is the tree path's
+                update_wire_dtype=(
+                    None
+                    if isinstance(self._tx, optim_mod.FusedAdamW)
+                    else self._update_wire_dtype()
+                ),
             )
         self._state, metrics = self._fused(
             self._state,

@@ -114,12 +114,96 @@ def test_fused_lr_factor_freezes_update(mesh8):
     np.testing.assert_array_equal(np.asarray(jax.tree.leaves(s2.params)[0]), p0)
 
 
-def test_fused_rejects_sharded_policy(mesh8):
-    model = Net(upscale_factor=2)
+def test_fused_rejects_grad_sharded_policy(mesh8):
     tx = optim.FusedAdamW(lr=0.01)
 
     def loss_fn(params, batch, rng, model_state):
         return 0.0, {}
 
-    with pytest.raises(ValueError, match="replicated"):
+    with pytest.raises(ValueError, match="ZeRO-1"):
         TrainStep(loss_fn, tx, mesh8, ZeRO2())
+
+
+def test_fused_zero1_shards_flat_moments_and_matches_ddp(devices8):
+    """ZeRO-1 + FusedAdamW: the flat [N] mu/nu shard over dp (the
+    DeepSpeed flat-partition scheme as shardings) and numerics match the
+    replicated fused run."""
+    from pytorch_distributedtraining_tpu.parallel import ZeRO1
+    from pytorch_distributedtraining_tpu.runtime.mesh import (
+        MeshSpec,
+        make_mesh,
+    )
+
+    batch = _batch(16)
+    mesh = make_mesh(MeshSpec(dp=8), devices=devices8)
+    mesh1 = make_mesh(MeshSpec(dp=1), devices=devices8[:1])
+
+    def build(mesh_, policy):
+        model = Net(upscale_factor=2)
+        tx = optim.FusedAdamW(lr=3e-3, clip_grad_norm=0.1)
+
+        def loss_fn(params, b, rng, model_state):
+            lr_img, hr_img = b
+            out = model.apply({"params": params}, lr_img)
+            from pytorch_distributedtraining_tpu.losses import mse_loss
+
+            return mse_loss(out, hr_img), {}
+
+        state, shardings = create_train_state(
+            init_fn=lambda r: (
+                model.init(r, jnp.zeros((1, 8, 8, 3)))["params"],
+                {},
+            ),
+            tx=tx, mesh=mesh_, policy=policy,
+        )
+        step = TrainStep(
+            loss_fn, tx, mesh_, policy,
+            state_shardings=shardings, donate=False,
+        )
+        return state, step
+
+    s_z, step_z = build(mesh, ZeRO1(min_shard_size=1))
+    s_d, step_d = build(mesh1, DDP())
+    # the flat moments are actually sharded: each device holds 1/8
+    mu = s_z.opt_state.mu
+    assert mu.addressable_shards[0].data.shape[0] == mu.shape[0] // 8
+    for _ in range(3):
+        s_z, m_z = step_z(s_z, batch)
+        s_d, m_d = step_d(s_d, batch)
+        np.testing.assert_allclose(
+            float(m_z["loss"]), float(m_d["loss"]), rtol=2e-5
+        )
+    for a, b in zip(
+        jax.tree.leaves(s_z.params), jax.tree.leaves(s_d.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-6)
+
+
+def test_fused_update_wire_dtype_bounds_error():
+    """The bf16 update wire (OSS broadcast_fp16 twin) stays within bf16
+    rounding of the full-precision update."""
+    import flax.linen as nn
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(16)(x)
+
+    model = M()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))["params"]
+    g = jax.tree.map(lambda p: jnp.ones_like(p) * 0.1, params)
+    from jax.flatten_util import ravel_pytree
+
+    gflat = ravel_pytree(g)[0].astype(jnp.float32)
+    tx = optim.FusedAdamW(lr=1e-2)
+    tx_w = optim.FusedAdamW(lr=1e-2, update_wire_dtype=jnp.bfloat16)
+    p1, _, _ = tx.apply(gflat, tx.init(params), params)
+    p2, _, _ = tx_w.apply(gflat, tx_w.init(params), params)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        # close to the exact update, but not bit-identical (the wire
+        # narrowing must actually be in effect)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+    assert any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2))
+    )

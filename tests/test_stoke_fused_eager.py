@@ -158,6 +158,35 @@ def test_zero_grad_drops_window():
     assert np.isfinite(float(loss))  # handle still materializes
 
 
+def test_oss_facade_auto_selects_fused_and_shards_moments():
+    """fairscale_oss=True (ZeRO-1) + AdamW auto-selects FusedAdamW; the
+    flat moments shard over the 8-device dp mesh and the loop trains."""
+    from pytorch_distributedtraining_tpu import optim
+
+    s = Stoke(
+        model=Net(upscale_factor=2),
+        optimizer=StokeOptimizer(
+            optimizer="AdamW", optimizer_kwargs={"lr": 3e-3}
+        ),
+        loss=losses.mse_loss,
+        fairscale_oss=True,
+    )
+    assert isinstance(s._tx, optim.FusedAdamW)
+    x, y = _batch(16)
+    first = last = None
+    for _ in range(12):
+        out = s.model(x)
+        loss = s.loss(out, y)
+        s.backward(loss=loss)
+        s.step()
+        last = float(s.detach_and_sync_loss(loss))
+        first = first if first is not None else last
+    assert last < first
+    mu = s._state.opt_state.mu
+    n_dev = jax.device_count()
+    assert mu.addressable_shards[0].data.shape[0] == mu.shape[0] // n_dev
+
+
 def test_output_handle_resolves_from_fused_program():
     s = _stoke(True)
     x, y = _batch()
