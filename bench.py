@@ -37,7 +37,9 @@ import time
 BASELINE_IMG_PER_SEC = 6000.0  # per-chip A100-class estimate; see docstring
 BATCH = max(1, int(os.environ.get("GRAFT_BENCH_BATCH", "18")))  # Stoke-DDP.py:159
 PATCH = 64  # Stoke-DDP.py:207 img_size
-STEPS = max(1, int(os.environ.get("GRAFT_BENCH_STEPS", "20")))
+STEPS = max(1, int(os.environ.get("GRAFT_BENCH_STEPS", "200")))
+# 200 sustained, not 20: short windows ride the tunnel's dispatch queue
+# and overstate throughput by ~1.4x (BASELINE.md round-4 methodology)
 WARMUP = max(1, int(os.environ.get("GRAFT_BENCH_WARMUP", "3")))
 
 METRIC = "swinir_s_x2_train_images_per_sec_per_chip"

@@ -67,7 +67,13 @@ def _run_image(name, model, batch_size, img, policy, mesh, steps, warmup,
         TrainStep, create_train_state,
     )
 
-    tx = optim.adamw(lr=1e-3, clip_grad_norm=1.0)
+    # same auto-rule as the Stoke facade: replicated/ZeRO-1 layouts take
+    # the flat fused update (measured 2.6x step time, BASELINE.md r4)
+    tx = (
+        optim.FusedAdamW(lr=1e-3, clip_grad_norm=1.0)
+        if optim.fused_adamw_eligible(policy)
+        else optim.adamw(lr=1e-3, clip_grad_norm=1.0)
+    )
 
     def loss_fn(params, batch, rng, model_state):
         x, y = batch

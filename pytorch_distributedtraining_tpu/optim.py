@@ -240,6 +240,18 @@ class FusedAdamW:
         return new_params, new_opt, new_scaler, gnorm
 
 
+def fused_adamw_eligible(policy) -> bool:
+    """Can :class:`FusedAdamW` replace the per-leaf chain under this
+    parallelism policy?
+
+    Replicated (DDP) and ZeRO-1/OSS layouts qualify (flat moments shard
+    over dp); ZeRO-2/3 shard grads/params per leaf, which a flat vector
+    cannot express. The single source of truth for the Stoke facade's
+    auto-selection and the benchmark ladder.
+    """
+    return not (policy.shard_params or policy.shard_grads)
+
+
 OPTIMIZERS = {"adamw": adamw, "sgd": sgd}
 
 

@@ -370,8 +370,9 @@ class Stoke:
         # keep the chain layout — e.g. to .load() a checkpoint whose
         # opt_state was saved pre-fused (the pytrees are not
         # interchangeable).
-        fused_eligible = factory is optim_mod.adamw and not (
-            self.policy.shard_params or self.policy.shard_grads
+        fused_eligible = (
+            factory is optim_mod.adamw
+            and optim_mod.fused_adamw_eligible(self.policy)
         )
         if fused_optimizer is True and not fused_eligible:
             raise ValueError(

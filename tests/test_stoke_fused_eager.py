@@ -187,6 +187,39 @@ def test_oss_facade_auto_selects_fused_and_shards_moments():
     assert mu.addressable_shards[0].data.shape[0] == mu.shape[0] // n_dev
 
 
+def test_fused_opt_state_checkpoint_roundtrip(tmp_path):
+    """FusedAdamWState (count + flat padded mu/nu) survives save/load and
+    training resumes identically."""
+    import os
+
+    s = _stoke(True)
+    x, y = _batch()
+    for _ in range(3):
+        out = s.model(x)
+        loss = s.loss(out, y)
+        s.backward(loss=loss)
+        s.step()
+    path, _ = s.save(path=str(tmp_path), name="fused_ckpt")
+    assert os.path.exists(path)
+
+    s2 = _stoke(True)
+    s2.init(x)
+    s2.load(path)
+    np.testing.assert_array_equal(
+        np.asarray(s._state.opt_state.mu), np.asarray(s2._state.opt_state.mu)
+    )
+    assert int(s2._state.opt_state.count) == 3
+    for s_ in (s, s2):
+        out = s_.model(x)
+        loss = s_.loss(out, y)
+        s_.backward(loss=loss)
+        s_.step()
+    for a, b in zip(
+        jax.tree.leaves(s._state.params), jax.tree.leaves(s2._state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_output_handle_resolves_from_fused_program():
     s = _stoke(True)
     x, y = _batch()
