@@ -33,7 +33,7 @@ def main():
     from pytorch_distributedtraining_tpu.ops.pallas_attn import flash_attention
 
     B, H, D = 8, 12, 64
-    STEPS = int(os.environ.get("GRAFT_ATTN_STEPS", "20"))
+    STEPS = int(os.environ.get("GRAFT_ATTN_STEPS", "50"))
     platform = jax.devices()[0].platform
     if platform not in ("cpu", "tpu"):
         # make_flash_attn_fn silently falls back to XLA attention off

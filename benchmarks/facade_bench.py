@@ -25,7 +25,9 @@ import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 CPU_SELF_TEST = os.environ.get("GRAFT_BENCH_PLATFORM") == "cpu"
 STEPS = max(1, int(
-    os.environ.get("GRAFT_FACADE_STEPS", "4" if CPU_SELF_TEST else "20")))
+    # 200 sustained on chip (BASELINE.md r4 methodology: short windows
+    # ride the tunnel dispatch queue and distort ratios)
+    os.environ.get("GRAFT_FACADE_STEPS", "4" if CPU_SELF_TEST else "200")))
 WARMUP = max(1, int(
     os.environ.get("GRAFT_FACADE_WARMUP", "1" if CPU_SELF_TEST else "3")))
 BATCH = max(1, int(

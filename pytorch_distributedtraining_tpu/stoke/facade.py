@@ -159,29 +159,42 @@ class _LazyOutput(_LazyBase):
     without running the forward.
     """
 
-    __slots__ = ("_inputs", "_params", "_model_state", "_rng")
+    __slots__ = ("_inputs", "_params", "_model_state", "_rng_parts")
 
-    def __init__(self, facade, inputs, params, model_state, rng):
+    def __init__(self, facade, inputs, params, model_state, rng_parts):
         self._facade = facade
         self._inputs = inputs
         self._params = params
         self._model_state = model_state
-        self._rng = rng
+        # (base_rng, step): the fold_in happens lazily at materialization —
+        # an eager fold per .model() call costs ~1 ms of host dispatch on
+        # the hot loop for a handle that usually resolves from the fused
+        # program instead
+        self._rng_parts = rng_parts
         self._value = None
+
+    def _rng(self):
+        base, step = self._rng_parts
+        return jax.random.fold_in(base, step)
 
     def materialize(self):
         if self._value is None:
             self._value, _ = self._facade._jit_fwd(
-                self._params, self._model_state, self._inputs, self._rng,
+                self._params, self._model_state, self._inputs, self._rng(),
                 train=True,
             )
         return self._value
 
     @property
     def _aval(self):
+        base, step = self._rng_parts
+        # the fold happens abstractly inside eval_shape: shape queries
+        # must not pay the real fold_in dispatch
         out, _ = jax.eval_shape(
-            lambda p, m, x, r: self._facade._jit_fwd(p, m, x, r, train=True),
-            self._params, self._model_state, self._inputs, self._rng,
+            lambda p, m, x, b, s: self._facade._jit_fwd(
+                p, m, x, jax.random.fold_in(b, s), train=True
+            ),
+            self._params, self._model_state, self._inputs, base, step,
         )
         return out
 
@@ -623,21 +636,34 @@ class Stoke:
         # matching torch and the split eager path — TrainStep's scan
         # broadcasts the pre-step state instead).
         def eager_step(params, opt_state, scaler_state, model_state,
-                       micros, rng, lr):
+                       micros, rng_base, step_no, lr, ema, has_ema):
+            # fold in-program: an eager host-side fold_in costs ~1 ms of
+            # dispatch per step on the hot loop
+            rng = jax.random.fold_in(rng_base, step_no)
             gacc = None
             losses, outs = [], []
             ms = model_state
+            l32 = None
             for x, y in micros:
                 loss, out, ms, grads = loss_grad(
                     params, ms, x, y, rng, scaler_state
                 )
                 gacc = acc(gacc, grads)  # the split path's own fold
+                # loss monitor folded in-program (the split path
+                # dispatches _ema_update per backward): same 0.98-decay
+                # single source of truth; has_ema distinguishes "no EMA
+                # yet" from a genuinely-NaN EMA, which must propagate
+                l32 = jnp.mean(jnp.asarray(loss, jnp.float32))
+                ema = jnp.where(has_ema, _ema_update(ema, l32), l32)
+                has_ema = jnp.bool_(True)
                 losses.append(loss)
                 outs.append(out)
             new_params, new_opt, new_scaler = apply_updates(
                 params, opt_state, scaler_state, gacc, lr
             )
-            return losses, outs, ms, new_params, new_opt, new_scaler
+            return (
+                losses, outs, ms, new_params, new_opt, new_scaler, ema, l32
+            )
 
         self._jit_eager_step = jax.jit(
             eager_step,
@@ -649,6 +675,9 @@ class Stoke:
                 None,
                 None,
                 None,
+                None,
+                None,
+                None,
             ),
             out_shardings=(
                 None,
@@ -657,6 +686,8 @@ class Stoke:
                 self._shardings.params,
                 self._shardings.opt_state,
                 self._shardings.scaler,
+                None,
+                None,
             ),
             donate_argnums=(0, 1),
         )
@@ -678,7 +709,7 @@ class Stoke:
         if self._training:
             lazy = _LazyOutput(
                 self, inputs, self._state.params, self._state.model_state,
-                jax.random.fold_in(self._state.rng, self._state.step),
+                (self._state.rng, self._state.step),
             )
             self._lazy_output = lazy
             self._pending_lazies.append(weakref.ref(lazy))
@@ -869,23 +900,33 @@ class Stoke:
             ):
                 lazy.materialize()
         self._pending_lazies = []
-        rng = jax.random.fold_in(self._state.rng, self._state.step)
         micros = tuple((x, y) for x, y, _, _ in window)
-        losses, outs, new_ms, new_params, new_opt, new_scaler = (
-            self._jit_eager_step(
-                self._state.params,
-                self._state.opt_state,
-                self._state.scaler,
-                self._state.model_state,
-                micros,
-                rng,
-                jnp.float32(self._opt_handle.lr),
-            )
+        has_ema = self._ema_dev is not None
+        ema_in = self._ema_dev if has_ema else jnp.float32(0.0)
+        (
+            losses, outs, new_ms, new_params, new_opt, new_scaler,
+            new_ema, last_l32,
+        ) = self._jit_eager_step(
+            self._state.params,
+            self._state.opt_state,
+            self._state.scaler,
+            self._state.model_state,
+            micros,
+            self._state.rng,
+            self._state.step,
+            jnp.float32(self._opt_handle.lr),
+            ema_in,
+            jnp.bool_(has_ema),
         )
+        # EMA/last-loss bookkeeping came back from the program itself —
+        # no per-micro _note_loss dispatches on the fused path (last_l32
+        # is the final micro's scalar mean, matching _note_loss's
+        # non-scalar-loss reduction)
+        self._ema_dev = new_ema
+        self._last_loss_dev = last_l32
         for (_, _, lazy_loss, lazy_out), loss_val, out in zip(
             window, losses, outs
         ):
-            self._note_loss(loss_val)
             # `is None` guards: a handle the user force-materialized
             # mid-window keeps its observed value (the fused program's
             # differently-fused result could round differently)
