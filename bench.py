@@ -566,12 +566,28 @@ def _bench() -> None:
 
                 return lax.scan(body, s, None, length=STEPS)
 
+            t_c = time.perf_counter()
             state, losses = multi_step(state)  # compile + warmup
             jax.block_until_ready(losses)
+            print(
+                f"# child: scan compile+first-run "
+                f"{time.perf_counter() - t_c:.1f}s",
+                flush=True,
+            )
             t0 = time.perf_counter()
             state, losses = multi_step(state)
             jax.block_until_ready(losses)
             dt = time.perf_counter() - t0
+            # second timed replay: separates a per-call constant (program
+            # upload / remote dispatch) from true per-step cost
+            t1 = time.perf_counter()
+            state, losses = multi_step(state)
+            jax.block_until_ready(losses)
+            print(
+                f"# child: scan replay1 {dt:.2f}s replay2 "
+                f"{time.perf_counter() - t1:.2f}s",
+                flush=True,
+            )
         else:
             t0 = time.perf_counter()
             for _ in range(STEPS):

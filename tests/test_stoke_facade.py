@@ -163,8 +163,9 @@ def test_hot_loop_runs_single_fused_program():
 
 def test_hot_loop_never_blocks_host(monkeypatch):
     """The reference-shaped loop must not host-sync per step (VERDICT r2
-    weak #3): loss bookkeeping stays on device; only ``print_ema_loss`` /
-    ``_last_loss`` / explicit float() pull values to host."""
+    weak #3): loss bookkeeping stays on device; ``print_ema_loss`` rides
+    an async background fetch, so only ``_last_loss`` /
+    ``detach_and_sync_loss`` / explicit float() block the host."""
     s = _stoke(grad_accum_steps=1, verbose=True)
     x, y = _batch(seed=11)
     s.init(x)
@@ -184,10 +185,16 @@ def test_hot_loop_never_blocks_host(monkeypatch):
         s.step()
         sum_loss += s.detach_and_sync_loss(l)
     assert pulls["n"] == 0, "hot loop host-synced via device_get"
-    # the log points are where the sync happens, by design
+    # verbose printing rides the async fetcher (np.asarray in a daemon
+    # thread) — no blocking device_get even at the log points
     s.print_ema_loss()
-    assert pulls["n"] == 1
-    assert s._last_loss == pytest.approx(float(l))
+    assert pulls["n"] == 0
+    assert s._ema_async.flush() is not None  # a real value was fetched
+    # exact reads are the only blocking points, by design
+    lv = float(l)  # explicit materialization of the lazy loss
+    n0 = pulls["n"]
+    assert s._last_loss == pytest.approx(lv)
+    assert pulls["n"] == n0 + 1  # _last_loss: exactly one blocking read
     assert float(sum_loss) > 0
 
 
