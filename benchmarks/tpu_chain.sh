@@ -73,6 +73,7 @@ run bench_fused_bf16ln 390 env GRAFT_BENCH_KNOBS=0 GRAFT_BENCH_TOTAL=360 GRAFT_B
 run bench_fused_combo 390 env GRAFT_BENCH_KNOBS=0 GRAFT_BENCH_TOTAL=360 GRAFT_BENCH_STEPS=200 GRAFT_BENCH_OPT=fused GRAFT_BENCH_ATTN=pallas GRAFT_BENCH_ATTN_PACK=2 GRAFT_BENCH_NORM=bf16 python bench.py
 run bench_fused_paired 390 env GRAFT_BENCH_KNOBS=0 GRAFT_BENCH_TOTAL=360 GRAFT_BENCH_STEPS=200 GRAFT_BENCH_OPT=fused GRAFT_BENCH_ATTN=paired python bench.py
 run bench_scan   540 env GRAFT_BENCH_KNOBS=0 GRAFT_BENCH_TOTAL=500 GRAFT_BENCH_STEPS=200 GRAFT_BENCH_OPT=fused GRAFT_BENCH_LOOP=scan python bench.py
+run bench_scan_k10 540 env GRAFT_BENCH_KNOBS=0 GRAFT_BENCH_TOTAL=500 GRAFT_BENCH_STEPS=200 GRAFT_BENCH_OPT=fused GRAFT_BENCH_LOOP=scan GRAFT_BENCH_SCAN_K=10 python bench.py
 run bench_b36_fused 390 env GRAFT_BENCH_KNOBS=0 GRAFT_BENCH_TOTAL=360 GRAFT_BENCH_STEPS=200 GRAFT_BENCH_OPT=fused GRAFT_BENCH_BATCH=36 python bench.py
 run facade       900 python benchmarks/facade_bench.py
 run offload      700 python benchmarks/offload_smoke.py
