@@ -493,6 +493,18 @@ def _bench() -> None:
     loop_impl = knob("GRAFT_BENCH_LOOP", "loop", "host")
     if loop_impl not in ("host", "scan"):
         raise SystemExit(f"loop must be 'host' or 'scan', got {loop_impl!r}")
+
+    # timing-loop knobs parse HERE, before any compile time is spent —
+    # same never-benchmark-a-mislabeled-arm convention as attn_pack/opt
+    def int_env(name: str, default: str) -> int:
+        raw = os.environ.get(name, default)
+        try:
+            return int(raw)
+        except ValueError:
+            raise SystemExit(f"{name} must be an int, got {raw!r}")
+
+    windows = max(1, int_env("GRAFT_BENCH_WINDOWS", "3"))
+    scan_k_raw = int_env("GRAFT_BENCH_SCAN_K", "0")
     if any(src != "default" for _, src in resolved.values()):
         # the EFFECTIVE config (env > json > default), not the raw file —
         # result logs must attribute numbers to what actually ran
@@ -559,7 +571,6 @@ def _bench() -> None:
         # still the 200-step sustained methodology; taking the best of N
         # reports the chip's capability rather than the instantaneous
         # tunnel weather, and every window is logged for transparency.
-        windows = max(1, int(os.environ.get("GRAFT_BENCH_WINDOWS", "3")))
         rates: list[float] = []
         if loop_impl == "scan":
             from functools import partial
@@ -569,9 +580,17 @@ def _bench() -> None:
             # k steps per dispatch (default: the whole window in one call).
             # Small k amortizes the tunnel's per-dispatch cost by k while
             # keeping the program and its upload size bounded.
-            k_raw = int(os.environ.get("GRAFT_BENCH_SCAN_K", "0"))
-            k = max(1, min(k_raw, STEPS)) if k_raw > 0 else STEPS
-            n_calls = max(1, STEPS // k)
+            k = max(1, min(scan_k_raw, STEPS)) if scan_k_raw > 0 else STEPS
+            # ceil: a window never runs FEWER than STEPS steps, so every
+            # K value still measures (at least) the committed sustained
+            # methodology; the rate math below uses the true k*n_calls
+            n_calls = -(-STEPS // k)
+            if k * n_calls != STEPS:
+                print(
+                    f"# child: scan k={k} does not divide STEPS={STEPS}; "
+                    f"windows run {k * n_calls} steps",
+                    flush=True,
+                )
 
             @partial(jax.jit, donate_argnums=0)
             def multi_step(s):

@@ -1,0 +1,119 @@
+"""FSDP / ZeRO-3 on GPT-2: full sharding as a PartitionSpec policy.
+
+Fairscale's FSDP flat-shards params and inserts per-module
+all-gather/reduce-scatter from Python hooks. Here ZeRO-3 is ~30 lines of
+policy (`parallel/policy.py`): params, grads, and optimizer state carry
+sharded `PartitionSpec`s, and XLA schedules the all-gathers into the
+compiled step where they overlap with compute.
+
+Demonstrates: the ZeRO ladder (ZeRO1 -> ZeRO2 -> ZeRO3 are layout
+choices), printable shardings, per-device memory arithmetic, and loss
+parity with plain DDP on the same data.
+
+Fakes 8 devices on the host CPU; ``EXAMPLE_PLATFORM=tpu`` uses the real
+mesh instead.
+"""
+
+import _bootstrap
+
+_bootstrap.setup(n_devices=8)
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributedtraining_tpu import optim
+from pytorch_distributedtraining_tpu.models import GPT2, GPT2Config, cross_entropy_loss
+from pytorch_distributedtraining_tpu.parallel import (
+    DDP,
+    ZeRO3,
+    TrainStep,
+    create_train_state,
+)
+from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
+
+B, T = 8, 64
+
+
+def build(mesh, policy):
+    cfg = GPT2Config.tiny(n_embd=64, n_layer=2, n_head=4, n_positions=T)
+    model = GPT2(cfg)
+
+    def loss_fn(params, batch, rng, model_state):
+        tokens, targets = batch
+        logits = model.apply({"params": params}, tokens)
+        return cross_entropy_loss(logits, targets), {}
+
+    tx = optim.adamw(lr=3e-4, clip_grad_norm=1.0)
+    state, shardings = create_train_state(
+        init_fn=lambda r: (
+            model.init(r, jnp.zeros((1, T), jnp.int32))["params"], {},
+        ),
+        tx=tx, mesh=mesh, policy=policy,
+    )
+    step = TrainStep(
+        loss_fn, tx, mesh, policy, state_shardings=shardings, donate=False
+    )
+    return state, shardings, step
+
+
+def batches(n, vocab, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        tok = rng.integers(0, vocab, (B, T + 1))
+        yield (
+            jnp.asarray(tok[:, :-1], jnp.int32),
+            jnp.asarray(tok[:, 1:], jnp.int32),
+        )
+
+
+def bytes_per_device(state, mesh):
+    """Param + opt-state bytes actually resident on ONE device."""
+    n_dev = len(mesh.devices.ravel())
+    leaves = [
+        x
+        for x in jax.tree.leaves((state.params, state.opt_state))
+        if hasattr(x, "addressable_shards")
+    ]
+    total = sum(x.size * x.dtype.itemsize for x in leaves)
+    # one shard per leaf = that device's resident bytes (a replicated leaf's
+    # shard is the full array, so DDP correctly reports total bytes/device)
+    resident = sum(
+        x.addressable_shards[0].data.size * x.dtype.itemsize for x in leaves
+    )
+    return total, resident, n_dev
+
+
+def main():
+    vocab = GPT2Config.tiny().vocab_size
+    mesh = make_mesh(MeshSpec.zero(8))
+    state, shardings, step = build(mesh, ZeRO3(min_shard_size=1))
+
+    # a couple of real shardings, straight off the state
+    flat = jax.tree_util.tree_leaves_with_path(shardings.params)[:3]
+    for path, s in flat:
+        print(f"param{jax.tree_util.keystr(path)}: spec={s.spec}")
+
+    total, resident, n_dev = bytes_per_device(state, mesh)
+    print(f"state bytes total {total/1e6:.2f} MB; "
+          f"resident/device ~{resident/1e6:.2f} MB on {n_dev} devices")
+
+    with mesh:
+        for i, batch in enumerate(batches(8, vocab)):
+            state, metrics = step(state, batch)
+    loss_fsdp = float(metrics["loss"])
+
+    # parity: DDP on the same stream
+    state_d, _, step_d = build(mesh, DDP())
+    with mesh:
+        for batch in batches(8, vocab):
+            state_d, metrics_d = step_d(state_d, batch)
+    print(f"ZeRO-3 loss {loss_fsdp:.6f} vs DDP loss "
+          f"{float(metrics_d['loss']):.6f}")
+    assert abs(loss_fsdp - float(metrics_d["loss"])) < 1e-3
+    print("sharding the state changed memory, not math")
+
+
+if __name__ == "__main__":
+    main()

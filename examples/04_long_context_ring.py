@@ -1,0 +1,61 @@
+"""Long-context attention: ring + Ulysses sequence parallelism.
+
+When one device can't hold a sequence's attention, shard the sequence over
+the mesh's ``sp`` axis. Two interchangeable implementations
+(`ops/ring_attention.py`):
+
+- **ring**: K/V blocks rotate around the axis via ``ppermute`` while each
+  device accumulates its queries' output with an online softmax — O(T/n)
+  memory per device, compute overlaps the ring hops on real ICI.
+- **ulysses**: all-to-all swaps the shard axis from sequence to heads, runs
+  dense local attention, swaps back — cheaper at moderate T, needs
+  heads % sp == 0.
+
+Both are drop-in attention functions: the same GPT-2 runs dense or
+sequence-parallel depending on the mesh, and the outputs match to fp32
+tolerance.
+
+Fakes 8 devices on the host CPU; ``EXAMPLE_PLATFORM=tpu`` uses the real
+mesh instead.
+"""
+
+import _bootstrap
+
+_bootstrap.setup(n_devices=8)
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributedtraining_tpu.models.gpt2 import default_attention
+from pytorch_distributedtraining_tpu.ops import make_ring_attn_fn
+from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
+
+B, T, H, DH = 2, 512, 8, 16  # sequence length 512 split 8 ways -> 64/device
+
+
+def main():
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.normal(size=(B, T, H, DH)).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+
+    ref = default_attention(q, k, v, causal=True)  # dense, one device
+
+    mesh = make_mesh(MeshSpec(sp=8))
+    for impl in ("ring", "ulysses"):
+        attn = make_ring_attn_fn(mesh, impl=impl)
+        with jax.set_mesh(mesh):
+            out = jax.jit(lambda q, k, v: attn(q, k, v, causal=True))(q, k, v)
+        err = float(jnp.max(jnp.abs(out - ref)))
+        per_dev = T // 8
+        print(f"{impl:8s}: T={T} split over sp=8 ({per_dev}/device), "
+              f"max|err| vs dense = {err:.2e}")
+        assert err < 2e-4
+
+    print("sequence parallelism reproduced dense attention exactly")
+
+
+if __name__ == "__main__":
+    main()

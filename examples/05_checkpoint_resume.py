@@ -1,0 +1,109 @@
+"""Sharded checkpoints: save mid-run, "preempt", resume bit-identically.
+
+The reference can save per-epoch (`Stoke-DDP.py:137-147`) but has no resume
+path at all — no optimizer state, no RNG, no scheduler. This framework
+checkpoints the FULL train state (params + sharded optimizer state + step
+counter + RNG) per-shard via orbax, with a step-based manager that GCs old
+checkpoints and saves immediately on SIGTERM (preemption).
+
+Demonstrates: CheckpointManager save/restore under a ZeRO-2 layout,
+continuation equivalence (resumed run == uninterrupted run, exactly), and
+cross-layout restore (the ZeRO-2 checkpoint restored into a DDP layout).
+
+Fakes 8 devices on the host CPU; ``EXAMPLE_PLATFORM=tpu`` uses the real
+mesh instead.
+"""
+
+import shutil
+import tempfile
+
+import _bootstrap
+
+_bootstrap.setup(n_devices=8)
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from pytorch_distributedtraining_tpu import optim
+from pytorch_distributedtraining_tpu.checkpoint_sharded import CheckpointManager
+from pytorch_distributedtraining_tpu.losses import mse_loss
+from pytorch_distributedtraining_tpu.models import Net
+from pytorch_distributedtraining_tpu.parallel import (
+    DDP,
+    ZeRO2,
+    TrainStep,
+    create_train_state,
+)
+from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
+
+
+def build(policy):
+    mesh = make_mesh(MeshSpec.zero(8))
+    model = Net(upscale_factor=2)
+    tx = optim.adamw(lr=1e-3)
+
+    def loss_fn(params, batch, rng, ms):
+        lo, hr = batch
+        return mse_loss(model.apply({"params": params}, lo), hr), {}
+
+    state, shardings = create_train_state(
+        init_fn=lambda r: (
+            model.init(r, jnp.zeros((1, 8, 8, 3)))["params"], {},
+        ),
+        tx=tx, mesh=mesh, policy=policy,
+    )
+    step = TrainStep(
+        loss_fn, tx, mesh, policy, state_shardings=shardings, donate=False
+    )
+    return mesh, state, step
+
+
+def batch_at(i):
+    rng = np.random.default_rng(100 + i)
+    hr = rng.random((16, 16, 16, 3)).astype(np.float32)
+    return hr.reshape(16, 8, 2, 8, 2, 3).mean(axis=(2, 4)), hr
+
+
+def main():
+    root = tempfile.mkdtemp(prefix="ckpt_example_")
+    try:
+        mgr = CheckpointManager(root, save_every=5, keep=2)
+
+        # -- run A: train 8 steps; the step-5 checkpoint is mid-run --------
+        mesh, state, step = build(ZeRO2(min_shard_size=1))
+        with mesh:
+            for i in range(8):
+                state, metrics = step(state, batch_at(i))
+                mgr.maybe_save(int(state.step), state)
+        loss_a = float(metrics["loss"])
+        print(f"run A finished at step {int(state.step)}, "
+              f"loss {loss_a:.6f}; checkpoints: {mgr.all_steps()}")
+
+        # -- run B: fresh process-equivalent, resume from step 5 -----------
+        mesh_b, state_b, step_b = build(ZeRO2(min_shard_size=1))
+        latest, state_b = mgr.restore_latest(state_b)
+        print(f"run B resumed from step {int(state_b.step)}")
+        with mesh_b:
+            for i in range(int(state_b.step), 8):
+                state_b, metrics_b = step_b(state_b, batch_at(i))
+        loss_b = float(metrics_b["loss"])
+        print(f"run B loss {loss_b:.6f} (uninterrupted was {loss_a:.6f})")
+        assert loss_a == loss_b, "resume must be bit-identical"
+
+        # -- cross-layout: the ZeRO-2 checkpoint into a DDP layout ---------
+        mesh_c, state_c, step_c = build(DDP())
+        _, state_c = mgr.restore_latest(state_c)
+        with mesh_c:
+            for i in range(int(state_c.step), 8):
+                state_c, metrics_c = step_c(state_c, batch_at(i))
+        print(f"run C (DDP layout from ZeRO-2 ckpt) loss "
+              f"{float(metrics_c['loss']):.6f}")
+        assert abs(float(metrics_c["loss"]) - loss_a) < 1e-6
+        print("resume equivalence holds, including across layouts")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

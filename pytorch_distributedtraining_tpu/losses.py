@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def mse_loss(outputs, targets):
@@ -25,10 +26,15 @@ def l1_loss(outputs, targets):
     return jnp.mean(jnp.abs(outputs - targets))
 
 
-def _fixed_filters(key, cin: int, cout: int):
-    """Deterministic random 3x3 filters (HWIO), unit-normalized."""
-    w = jax.random.normal(key, (3, 3, cin, cout), dtype=jnp.float32)
-    return w / jnp.sqrt(jnp.sum(w**2, axis=(0, 1, 2), keepdims=True) + 1e-8)
+def _fixed_filters(rng, cin: int, cout: int):
+    """Deterministic random 3x3 filters (HWIO), unit-normalized.
+
+    Built with host numpy on purpose: constructing a loss object must not
+    initialize the jax backend (a driver imports ``feat_loss`` at module
+    top, and e.g. ``--help`` must work with no accelerator reachable).
+    """
+    w = rng.standard_normal((3, 3, cin, cout)).astype(np.float32)
+    return w / np.sqrt(np.sum(w**2, axis=(0, 1, 2), keepdims=True) + 1e-8)
 
 
 def _feature_pyramid(x, filters):
@@ -51,11 +57,11 @@ class FeatLoss:
     """
 
     def __init__(self, depths=(16, 32, 64), pixel_weight: float = 1.0, seed: int = 0):
-        keys = jax.random.split(jax.random.PRNGKey(seed), len(depths))
+        rng = np.random.default_rng(seed)
         cins = (3,) + tuple(depths[:-1])
         self.filters = [
-            _fixed_filters(k, cin, cout)
-            for k, cin, cout in zip(keys, cins, depths)
+            _fixed_filters(rng, cin, cout)
+            for cin, cout in zip(cins, depths)
         ]
         self.pixel_weight = pixel_weight
 
@@ -128,8 +134,9 @@ class VGGFeatLoss:
 
 
 def __getattr__(name):
-    # `feat_loss` is built lazily: constructing its fixed filters touches the
-    # jax backend, which module import must not do
+    # `feat_loss` is built lazily so importing this module stays free of
+    # array construction entirely (filters are numpy, but even host arrays
+    # are pointless work for importers that never call the loss)
     if name == "feat_loss":
         obj = FeatLoss()
         globals()[name] = obj
