@@ -149,10 +149,9 @@ def main(argv=None):
     parser.add_argument("--synthetic-n", type=int, default=512)
     opt = parser.parse_args(argv)
 
-    # see drivers/stoke_ddp.py: config-API platform forcing for images whose
-    # sitecustomize re-latches JAX_PLATFORMS to an accelerator plugin
-    if os.environ.get("GRAFT_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["GRAFT_PLATFORM"])
+    # GRAFT_PLATFORM=cpu forces the backend (see runtime.dist docstring:
+    # some images re-latch JAX_PLATFORMS before user code runs)
+    runtime.force_platform_from_env()
 
     # env rendezvous exactly like the reference __main__ (:122-123); under
     # SPMD the single controller drives all devices, no mp.spawn fork

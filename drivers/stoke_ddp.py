@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pytorch_distributedtraining_tpu import metrics
+from pytorch_distributedtraining_tpu import metrics, runtime
 from pytorch_distributedtraining_tpu.data import (
     CustomDataset,
     DistributedSampler,
@@ -191,12 +191,9 @@ def main(argv=None):
     opt = build_parser().parse_args(argv)
     epochs = opt.nEpochs
 
-    # GRAFT_PLATFORM=cpu forces the backend via the config API. The env var
-    # JAX_PLATFORMS alone is not always enough: images whose sitecustomize
-    # registers an accelerator PJRT plugin re-latch it before user code runs
-    # (same quirk bench.py's GRAFT_BENCH_PLATFORM works around).
-    if os.environ.get("GRAFT_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["GRAFT_PLATFORM"])
+    # GRAFT_PLATFORM=cpu forces the backend (see runtime.dist docstring:
+    # some images re-latch JAX_PLATFORMS before user code runs)
+    runtime.force_platform_from_env()
 
     amp_config = AMPConfig(init_scale=2.0**14)
     local_rank = os.getenv("LOCAL_RANK")

@@ -32,12 +32,16 @@ def setup(n_devices: int = 1) -> None:
         ).strip()
     # XLA:CPU's AOT loader logs a spurious "machine features don't match"
     # ERROR on warm cache loads even on the machine that wrote the cache
-    # (see __graft_entry__.py); the machine-keyed cache dir below closes
-    # the real cross-machine risk, so keep example output readable.
+    # (see __graft_entry__.py). This silences it on machines where jax is
+    # not yet imported; images whose sitecustomize pre-imports jaxlib have
+    # already latched the C++ log level, and the lines stay (cosmetic).
     os.environ["TF_CPP_MIN_LOG_LEVEL"] = "3"
+    os.environ["GRAFT_PLATFORM"] = "cpu"
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
+    from pytorch_distributedtraining_tpu.runtime import force_platform_from_env
+
+    force_platform_from_env()
     jax.config.update("jax_num_cpu_devices", n_devices)
     # persistent compile cache (machine-keyed): repeat runs start fast
     from pytorch_distributedtraining_tpu.runtime.cache import cache_dir

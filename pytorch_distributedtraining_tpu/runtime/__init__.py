@@ -17,6 +17,7 @@ from .dist import (
     local_device_count,
     device_count,
     find_free_port,
+    force_platform_from_env,
 )
 from .mesh import (
     MeshSpec, make_mesh, make_hybrid_mesh, best_mesh, mesh_axis_size,
@@ -34,6 +35,7 @@ __all__ = [
     "local_device_count",
     "device_count",
     "find_free_port",
+    "force_platform_from_env",
     "MeshSpec",
     "make_mesh",
     "make_hybrid_mesh",

@@ -1,0 +1,69 @@
+"""Importing the package must never initialize a jax backend.
+
+Regression guard for the class of bug found in round 4: ``FeatLoss``
+construction ran ``jax.random`` ops, so ``from ...losses import
+feat_loss`` (the first line of a driver) initialized the backend — which
+HANGS on machines whose configured accelerator is unreachable, breaking
+even ``--help``. Every module, every public drag-in symbol (`__getattr__`
+lazies included), and both driver modules must import with zero backends
+live.
+
+Runs in a subprocess because this process's conftest already initialized
+the CPU backend.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PROBE = r"""
+import os, pkgutil, sys
+sys.path.insert(0, {repo!r})
+
+import pytorch_distributedtraining_tpu as pkg
+
+mods = [pkg.__name__]
+for m in pkgutil.walk_packages(pkg.__path__, prefix=pkg.__name__ + "."):
+    if "_fastpipe" in m.name:
+        continue  # ctypes .so (bound via csrc/__init__), not a Py module
+    mods.append(m.name)
+for name in sorted(mods):
+    __import__(name)
+
+# module-level lazies a driver pulls in at import time
+from pytorch_distributedtraining_tpu.losses import feat_loss  # noqa: F401
+
+import importlib.util
+for drv in ("stoke_ddp", "fairscale_ddp"):
+    spec = importlib.util.spec_from_file_location(
+        drv, os.path.join({repo!r}, "drivers", drv + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+from jax._src import xla_bridge
+live = list(xla_bridge._backends)
+assert not live, f"backend(s) initialized at import time: {{live}}"
+print("IMPORT-HYGIENE-OK", len(mods), "modules")
+"""
+
+
+def test_no_backend_init_at_import():
+    env = dict(os.environ)
+    # plain env; the probe itself must not need config-API forcing because
+    # nothing in it may touch a backend at all
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE.format(repo=REPO)],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, (
+        f"import-hygiene probe failed rc={proc.returncode}\n"
+        f"stdout: {proc.stdout[-2000:]}\nstderr: {proc.stderr[-2000:]}"
+    )
+    assert "IMPORT-HYGIENE-OK" in proc.stdout
