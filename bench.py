@@ -342,18 +342,17 @@ def _unblock_inherited_mask() -> None:
 
 
 def _force_platform() -> None:
-    """Honor GRAFT_BENCH_PLATFORM via the config API.
+    """Honor GRAFT_BENCH_PLATFORM (envelope self-tests off-TPU).
 
-    The image's sitecustomize re-latches ``JAX_PLATFORMS=axon`` during its
-    PJRT plugin registration, so the env var alone cannot select CPU; the
-    config API (applied after import, before backend init) can. Used for
-    envelope self-tests on machines without a live TPU.
+    Delegates to the shared config-API workaround for images whose
+    sitecustomize re-latches ``JAX_PLATFORMS`` (package import is safe
+    here: the import-hygiene test guarantees it initializes no backend).
     """
-    plat = os.environ.get("GRAFT_BENCH_PLATFORM")
-    if plat:
-        import jax
+    from pytorch_distributedtraining_tpu.runtime.dist import (
+        force_platform_from_env,
+    )
 
-        jax.config.update("jax_platforms", plat)
+    force_platform_from_env("GRAFT_BENCH_PLATFORM")
 
 
 def _probe() -> None:
@@ -573,13 +572,11 @@ def _bench() -> None:
         # tunnel weather, and every window is logged for transparency.
         rates: list[float] = []
         if loop_impl == "scan":
-            from functools import partial
-
-            import jax.lax as lax
+            from pytorch_distributedtraining_tpu.parallel import MultiStep
 
             # k steps per dispatch (default: the whole window in one call).
             # Small k amortizes the tunnel's per-dispatch cost by k while
-            # keeping the program and its upload size bounded.
+            # keeping the program and the stacked batch size bounded.
             k = max(1, min(scan_k_raw, STEPS)) if scan_k_raw > 0 else STEPS
             # ceil: a window never runs FEWER than STEPS steps, so every
             # K value still measures (at least) the committed sustained
@@ -591,14 +588,43 @@ def _bench() -> None:
                     f"windows run {k * n_calls} steps",
                     flush=True,
                 )
+            if k <= 32:
+                # the public-API path: a real [k, B, ...] stack, so the
+                # scan body reads a distinct batch per step like real
+                # training (not a loop-invariant constant XLA could hoist)
+                from pytorch_distributedtraining_tpu.parallel import (
+                    MultiStep,
+                )
 
-            @partial(jax.jit, donate_argnums=0)
-            def multi_step(s):
-                def body(s, _):
-                    s2, m = step._step(s, batch, jnp.float32(1.0))
+                multi_api = MultiStep(step, k=k)
+                stacked = jax.tree.map(
+                    lambda x: jax.device_put(
+                        np.broadcast_to(np.asarray(x)[None], (k,) + x.shape)
+                    ),
+                    batch,
+                )
+
+                def multi_step(s):
+                    s2, m = multi_api(s, stacked)
                     return s2, m["loss"]
 
-                return lax.scan(body, s, None, length=k)
+            else:
+                # deep windows (default k=STEPS=200) stay on a closure-
+                # constant batch: a materialized 200-deep stack would be
+                # ~900 MB of HBM + upload, distorting the dispatch-cost
+                # diagnostic this arm exists for — it measures per-call
+                # overhead, not input-pipeline fidelity
+                from functools import partial
+
+                import jax.lax as lax
+
+                @partial(jax.jit, donate_argnums=0)
+                def multi_step(s):
+                    def body(s, _):
+                        s2, m = step._step(s, batch, jnp.float32(1.0))
+                        return s2, m["loss"]
+
+                    return lax.scan(body, s, None, length=k)
 
             t_c = time.perf_counter()
             state, losses = multi_step(state)  # compile + warmup

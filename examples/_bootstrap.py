@@ -36,12 +36,11 @@ def setup(n_devices: int = 1) -> None:
     # not yet imported; images whose sitecustomize pre-imports jaxlib have
     # already latched the C++ log level, and the lines stay (cosmetic).
     os.environ["TF_CPP_MIN_LOG_LEVEL"] = "3"
-    os.environ["GRAFT_PLATFORM"] = "cpu"
     import jax
 
-    from pytorch_distributedtraining_tpu.runtime import force_platform_from_env
+    from pytorch_distributedtraining_tpu.runtime.dist import force_platform
 
-    force_platform_from_env()
+    force_platform("cpu")
     jax.config.update("jax_num_cpu_devices", n_devices)
     # persistent compile cache (machine-keyed): repeat runs start fast
     from pytorch_distributedtraining_tpu.runtime.cache import cache_dir

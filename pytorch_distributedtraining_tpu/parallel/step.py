@@ -120,6 +120,7 @@ class TrainStep:
             )
         if detect_anomaly:
             donate = False
+        self.donate = donate  # MultiStep mirrors this choice
 
         self._state_shardings = state_shardings
         if (
@@ -380,6 +381,73 @@ class TrainStep:
 
     def __call__(self, state: TrainState, batch, lr_factor: float = 1.0):
         return self._jitted(state, batch, jnp.float32(lr_factor))
+
+
+class MultiStep:
+    """K train steps as ONE compiled program (`lax.scan` over stacked
+    batches).
+
+    Amortizes per-dispatch host/link cost by K. The round-4 on-chip data
+    (BASELINE.md) showed the flagship batch-18 step is dispatch-bound, not
+    FLOP-bound: the chip runs the same model ~2x faster at batch 72, and a
+    1-core host tops out at ~1.5 ms/dispatch. When the host (or a remote
+    dispatch link) is the bottleneck, wrap the step and stack K batches::
+
+        multi = MultiStep(step, k=8)
+        it = iter(loader)
+        window = [next(it) for _ in range(8)]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *window)
+        state, metrics = multi(state, stacked)      # one dispatch
+
+    Semantics vs. K ``step()`` calls: identical math, including the
+    per-step rng fold (``state.step`` advances inside the scan). Metrics
+    come back stacked ``[K]`` per entry (take ``[-1]`` or a mean).
+    ``lr_factor`` is constant across the window — per-step schedules that
+    must change within K steps (OneCycle per-batch) should either keep
+    K small relative to the schedule's rate of change or stay on the
+    single-step path.
+    """
+
+    def __init__(self, step: TrainStep, k: int):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.step = step
+        self.k = int(k)
+        mesh = step.mesh
+        # stacked batches add a leading scan axis: shard everything after it
+        # exactly like the single-step batch
+        stacked_sharding = NamedSharding(
+            mesh, PartitionSpec(None, *batch_spec(mesh))
+        )
+        sh = step._state_shardings
+
+        def multi(state, batches, lr_factor):
+            def body(s, mb):
+                s2, m = step._step(s, mb, lr_factor)
+                return s2, m
+
+            return jax.lax.scan(body, state, batches)
+
+        self._jitted = jax.jit(
+            multi,
+            in_shardings=(sh, stacked_sharding, None),
+            out_shardings=(sh, None),
+            # mirror the wrapped step's choice: donate=False callers (incl.
+            # detect_anomaly's inspectable-pre-step-state contract) keep
+            # their input state valid here too
+            donate_argnums=(0,) if step.donate else (),
+        )
+
+    def __call__(self, state: TrainState, batches, lr_factor: float = 1.0):
+        """``batches`` leaves are ``[K, B, ...]`` stacks."""
+        k = jax.tree.leaves(batches)[0].shape[0]
+        if k != self.k:
+            raise ValueError(
+                f"stacked batch has window {k}, MultiStep compiled for "
+                f"{self.k}"
+            )
+        with self.step.mesh:
+            return self._jitted(state, batches, jnp.float32(lr_factor))
 
 
 class EvalStep:

@@ -17,6 +17,7 @@ from .dist import (
     local_device_count,
     device_count,
     find_free_port,
+    force_platform,
     force_platform_from_env,
 )
 from .mesh import (
@@ -35,6 +36,7 @@ __all__ = [
     "local_device_count",
     "device_count",
     "find_free_port",
+    "force_platform",
     "force_platform_from_env",
     "MeshSpec",
     "make_mesh",

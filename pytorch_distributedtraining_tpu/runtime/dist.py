@@ -35,19 +35,23 @@ logger = logging.getLogger(__name__)
 _INITIALIZED = False
 
 
-def force_platform_from_env(var: str = "GRAFT_PLATFORM") -> str | None:
-    """Force the jax platform via the config API when ``var`` is set.
+def force_platform(platform: str) -> None:
+    """Force the jax platform via the config API.
 
     The env var ``JAX_PLATFORMS`` alone is not always enough: images whose
     sitecustomize registers an accelerator PJRT plugin re-latch it before
     user code runs, so selecting e.g. CPU requires the config API — applied
-    after jax import but before any backend init. Returns the platform
-    applied, or None when ``var`` is unset/empty. One shared home for the
-    workaround (drivers, examples, envelope self-tests).
+    after jax import but before any backend init. One shared home for the
+    workaround (drivers, examples, bench envelope).
     """
+    jax.config.update("jax_platforms", platform)
+
+
+def force_platform_from_env(var: str = "GRAFT_PLATFORM") -> str | None:
+    """:func:`force_platform` from an env var; None when unset/empty."""
     plat = os.environ.get(var)
     if plat:
-        jax.config.update("jax_platforms", plat)
+        force_platform(plat)
     return plat or None
 
 
