@@ -572,8 +572,6 @@ def _bench() -> None:
         # tunnel weather, and every window is logged for transparency.
         rates: list[float] = []
         if loop_impl == "scan":
-            from pytorch_distributedtraining_tpu.parallel import MultiStep
-
             # k steps per dispatch (default: the whole window in one call).
             # Small k amortizes the tunnel's per-dispatch cost by k while
             # keeping the program and the stacked batch size bounded.

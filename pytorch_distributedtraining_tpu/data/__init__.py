@@ -13,7 +13,7 @@ from .dataset import (
     random_split,
 )
 from .sampler import DistributedSampler
-from .loader import DataLoader
+from .loader import DataLoader, stack_windows
 
 __all__ = [
     "Dataset",
@@ -24,4 +24,5 @@ __all__ = [
     "random_split",
     "DistributedSampler",
     "DataLoader",
+    "stack_windows",
 ]

@@ -42,6 +42,41 @@ def _process_worker_fetch(i):
     return _WORKER_DATASET[i]
 
 
+def stack_windows(batches, k: int):
+    """Group an iterable of batches into ``[k, B, ...]`` stacks.
+
+    The feed for :class:`~..parallel.MultiStep` (K train steps per
+    dispatch): yields one stacked pytree per K consecutive batches; a
+    trailing partial window is dropped (same contract as
+    ``drop_last=True`` — MultiStep is compiled for a fixed K).
+
+    ::
+
+        multi = MultiStep(step, k=8)
+        for stacked in stack_windows(loader, 8):
+            state, metrics = multi(state, stacked)
+    """
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    import jax
+    import jax.numpy as jnp
+
+    def stack(*xs):
+        # device-placed (possibly multi-host global) batches stack as an
+        # XLA op — np.stack would pull them to host (crashing on arrays
+        # spanning non-addressable devices, and round-tripping otherwise)
+        if hasattr(xs[0], "sharding"):
+            return jnp.stack(xs)
+        return np.stack(xs)
+
+    window = []
+    for b in batches:
+        window.append(b)
+        if len(window) == k:
+            yield jax.tree.map(stack, *window)
+            window = []
+
+
 def default_collate(samples):
     """Stack a list of samples; tuples/lists/namedtuples collate per-field.
 

@@ -103,3 +103,18 @@ def test_grad_accum_composes(devices8):
     state, m = multi(state, (lo, hr))
     assert int(state.step) == K
     assert np.isfinite(float(m["loss"][-1]))
+
+
+def test_stack_windows_feeds_multi(devices8):
+    from pytorch_distributedtraining_tpu.data import stack_windows
+
+    lo, hr = _batches(2 * K + 1)  # odd tail must be dropped
+    batches = [(lo[i], hr[i]) for i in range(2 * K + 1)]
+    mesh, state, step = _build(devices8, DDP())
+    multi = MultiStep(step, k=K)
+    n = 0
+    for stacked in stack_windows(batches, K):
+        assert stacked[0].shape == (K, B, 8, 8, 3)
+        state, m = multi(state, stacked)
+        n += 1
+    assert n == 2 and int(state.step) == 2 * K
