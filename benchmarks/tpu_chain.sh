@@ -66,6 +66,7 @@ run() { # name, timeout, cmd...
 # the tunnel's dispatch queue and overstate throughput — A/B arms run
 # STEPS=200 sustained. Headline stage stays at driver defaults
 # (committed bench_knobs.json supplies the measured winner).
+run dispatch_probe 300 python benchmarks/dispatch_probe.py
 run bench        420 python bench.py
 run bench_s200   390 env GRAFT_BENCH_TOTAL=360 GRAFT_BENCH_STEPS=200 python bench.py
 run bench_chain  390 env GRAFT_BENCH_KNOBS=0 GRAFT_BENCH_TOTAL=360 GRAFT_BENCH_STEPS=200 GRAFT_BENCH_OPT=chain python bench.py

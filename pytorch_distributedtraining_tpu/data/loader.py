@@ -56,25 +56,30 @@ def stack_windows(batches, k: int):
         for stacked in stack_windows(loader, 8):
             state, metrics = multi(state, stacked)
     """
-    if k < 1:
+    if k < 1:  # validate NOW, not at first iteration of the generator
         raise ValueError(f"k must be >= 1, got {k}")
-    import jax
-    import jax.numpy as jnp
 
-    def stack(*xs):
-        # device-placed (possibly multi-host global) batches stack as an
-        # XLA op — np.stack would pull them to host (crashing on arrays
-        # spanning non-addressable devices, and round-tripping otherwise)
-        if hasattr(xs[0], "sharding"):
-            return jnp.stack(xs)
-        return np.stack(xs)
+    def gen():
+        import jax
+        import jax.numpy as jnp
 
-    window = []
-    for b in batches:
-        window.append(b)
-        if len(window) == k:
-            yield jax.tree.map(stack, *window)
-            window = []
+        def stack(*xs):
+            # device-placed (possibly multi-host global) batches stack as
+            # an XLA op — np.stack would pull them to host (crashing on
+            # arrays spanning non-addressable devices, and round-tripping
+            # otherwise)
+            if hasattr(xs[0], "sharding"):
+                return jnp.stack(xs)
+            return np.stack(xs)
+
+        window = []
+        for b in batches:
+            window.append(b)
+            if len(window) == k:
+                yield jax.tree.map(stack, *window)
+                window = []
+
+    return gen()
 
 
 def default_collate(samples):

@@ -391,13 +391,12 @@ class MultiStep:
     (BASELINE.md) showed the flagship batch-18 step is dispatch-bound, not
     FLOP-bound: the chip runs the same model ~2x faster at batch 72, and a
     1-core host tops out at ~1.5 ms/dispatch. When the host (or a remote
-    dispatch link) is the bottleneck, wrap the step and stack K batches::
+    dispatch link) is the bottleneck, wrap the step and stack K batches
+    (:func:`~..data.stack_windows` handles host and device batches)::
 
         multi = MultiStep(step, k=8)
-        it = iter(loader)
-        window = [next(it) for _ in range(8)]
-        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *window)
-        state, metrics = multi(state, stacked)      # one dispatch
+        for stacked in stack_windows(loader, 8):    # leaves [8, B, ...]
+            state, metrics = multi(state, stacked)  # one dispatch
 
     Semantics vs. K ``step()`` calls: identical math, including the
     per-step rng fold (``state.step`` advances inside the scan). Metrics
