@@ -99,6 +99,11 @@ def _kill_child() -> None:
     _killpg(proc)
 
 
+_LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_LAST_GOOD.json"
+)
+
+
 def _emit_error(reason: str) -> None:
     """Print the structured error record exactly once and exit rc=1.
 
@@ -113,17 +118,43 @@ def _emit_error(reason: str) -> None:
         return
     _DONE = True
     _kill_child()
-    payload = json.dumps(
-        {
-            "metric": METRIC,
-            "value": 0.0,
-            "unit": UNIT,
-            "vs_baseline": 0.0,
-            "error": reason[:500],
-        }
-    )
-    os.write(1, ("\n" + payload + "\n").encode())
+    record = {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": UNIT,
+        "vs_baseline": 0.0,
+        "error": reason[:500],
+    }
+    # context, not substitution: the newest rc=0 measurement this machine
+    # produced (self-maintained by _emit_result). A pool outage at
+    # measurement time then still records WHAT the code measured when the
+    # chip last answered, clearly labeled as such.
+    try:
+        with open(_LAST_GOOD_PATH) as fh:
+            record["last_measured"] = json.load(fh)
+    except Exception:
+        pass
+    os.write(1, ("\n" + json.dumps(record) + "\n").encode())
     os._exit(1)
+
+
+_ARM_ENVS = (  # envs that change WHICH arm is being measured
+    "GRAFT_BENCH_OPT", "GRAFT_BENCH_ATTN", "GRAFT_BENCH_ATTN_PACK",
+    "GRAFT_BENCH_NORM", "GRAFT_BENCH_SOFTMAX", "GRAFT_BENCH_LOOP",
+    "GRAFT_BENCH_SCAN_K",
+)
+
+
+def _is_headline_config() -> bool:
+    """True when this run measures the shipped configuration (committed
+    knobs, stock batch) — the only runs allowed to refresh the last-good
+    record, so an outage record can never cite an ablation arm's number
+    as the headline's."""
+    return (
+        os.environ.get("GRAFT_BENCH_KNOBS") != "0"
+        and BATCH == 18
+        and not any(os.environ.get(v) for v in _ARM_ENVS)
+    )
 
 
 def _emit_result(line: str) -> None:
@@ -131,6 +162,17 @@ def _emit_result(line: str) -> None:
     if _DONE:
         return
     _DONE = True
+    try:  # best-effort: remember the measurement for outage error records
+        if _is_headline_config():
+            rec = json.loads(line)
+            rec["measured_at"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            )
+            rec["config"] = {"steps": STEPS, "batch": BATCH}
+            with open(_LAST_GOOD_PATH, "w") as fh:
+                json.dump(rec, fh)
+    except Exception:
+        pass
     os.write(1, ("\n" + line + "\n").encode())
     os._exit(0)
 

@@ -31,16 +31,23 @@ import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 
 def _timed_steps(step, state, batch, n_steps, warmup):
+    """Best-of-N windows (default 3): the shared pool's tunnel congestion
+    varies at the seconds scale (bench.py methodology, BASELINE.md r4) —
+    report the chip's capability, log nothing extra here."""
     import jax
 
+    windows = max(1, int(os.environ.get("GRAFT_LADDER_WINDOWS", "3")))
     for _ in range(warmup):
         state, metrics = step(state, batch)
         jax.block_until_ready(metrics["loss"])
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
-    return time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, metrics = step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        best = min(best, time.perf_counter() - t0)
+    return best
 
 
 def _mesh_for(policy_kind: str, tiny: bool):
