@@ -147,12 +147,15 @@ _ARM_ENVS = (  # envs that change WHICH arm is being measured
 
 def _is_headline_config() -> bool:
     """True when this run measures the shipped configuration (committed
-    knobs, stock batch) — the only runs allowed to refresh the last-good
-    record, so an outage record can never cite an ablation arm's number
-    as the headline's."""
+    knobs, stock batch, sustained methodology, real chip) — the only runs
+    allowed to refresh the last-good record, so an outage record can never
+    cite an ablation arm, a short-window run, or a CPU self-test as the
+    headline's number."""
     return (
         os.environ.get("GRAFT_BENCH_KNOBS") != "0"
+        and not os.environ.get("GRAFT_BENCH_PLATFORM")
         and BATCH == 18
+        and STEPS >= 100
         and not any(os.environ.get(v) for v in _ARM_ENVS)
     )
 
@@ -168,7 +171,11 @@ def _emit_result(line: str) -> None:
             rec["measured_at"] = time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
             )
-            rec["config"] = {"steps": STEPS, "batch": BATCH}
+            rec["config"] = {
+                "steps": STEPS,
+                "batch": BATCH,
+                "windows": int(os.environ.get("GRAFT_BENCH_WINDOWS", "3")),
+            }
             with open(_LAST_GOOD_PATH, "w") as fh:
                 json.dump(rec, fh)
     except Exception:

@@ -77,12 +77,21 @@ class CheckpointManager:
         save_every: int = 1000,
         keep: int = 3,
         handle_sigterm: bool = True,
+        async_save: bool = False,
     ):
         self.root = _abs(root)
         self.save_every = int(save_every)
         self.keep = int(keep)
         self._preempted = threading.Event()
         self._prev_handler = None
+        # async_save: ``save()`` returns once the device→host copy is done
+        # (orbax's async contract) and the disk write proceeds in the
+        # background — the train loop continues immediately, and donated
+        # next-step buffers are safe because the data already left the
+        # device. At most one save is in flight (back-pressure on the next
+        # save, not an unbounded queue).
+        self.async_save = bool(async_save)
+        self._async_ckptr = ocp.StandardCheckpointer() if async_save else None
         os.makedirs(self.root, exist_ok=True)
         if handle_sigterm and threading.current_thread() is threading.main_thread():
             self._prev_handler = signal.signal(signal.SIGTERM, self._on_sigterm)
@@ -121,9 +130,23 @@ class CheckpointManager:
     # -- save/restore ------------------------------------------------------
 
     def save(self, step: int, state: Any) -> str:
+        if self._async_ckptr is not None:
+            # previous in-flight save (if any) finishes first, and only
+            # COMPLETE checkpoints are GC'd before the new one starts
+            self._async_ckptr.wait_until_finished()
+            self._gc()
+            path = self._step_dir(step)
+            self._async_ckptr.save(path, state, force=True)
+            return path
         path = save_sharded(self._step_dir(step), state, force=True)
         self._gc()
         return path
+
+    def wait(self) -> None:
+        """Block until any in-flight async save has fully landed on disk."""
+        if self._async_ckptr is not None:
+            self._async_ckptr.wait_until_finished()
+            self._gc()  # the save that just landed now counts toward keep
 
     def _preempted_anywhere(self) -> bool:
         """Agree the (per-process) SIGTERM flag across all hosts.
@@ -148,13 +171,24 @@ class CheckpointManager:
         scheduled = (
             self.save_every > 0 and step > 0 and step % self.save_every == 0
         )
-        if scheduled or self._preempted_anywhere():
+        # the allgather runs unconditionally so every host takes the same
+        # branch AND the same wait() decision below — gating the wait on
+        # the local flag would leave non-signalled hosts' async writes in
+        # a background thread when the preemption kills them
+        anywhere = self._preempted_anywhere()
+        if scheduled or anywhere:
             self._preempted.clear()
-            return self.save(step, state)
+            path = self.save(step, state)
+            if anywhere:
+                # the job is about to die: the save must be ON DISK on
+                # every host, not in a background thread that dies with it
+                self.wait()
+            return path
         return None
 
     def restore_latest(self, template: Any) -> tuple[int, Any] | None:
         """(step, state) from the newest checkpoint, or None if fresh run."""
+        self.wait()  # an in-flight async save may be the latest
         step = self.latest_step()
         if step is None:
             return None
@@ -168,6 +202,10 @@ class CheckpointManager:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
     def close(self) -> None:
+        if self._async_ckptr is not None:
+            self.wait()
+            self._async_ckptr.close()
+            self._async_ckptr = None
         if self._prev_handler is not None:
             signal.signal(signal.SIGTERM, self._prev_handler)
             self._prev_handler = None
