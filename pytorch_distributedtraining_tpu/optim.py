@@ -29,6 +29,125 @@ from jax.flatten_util import ravel_pytree
 # -- optimizers --------------------------------------------------------------
 
 
+@jax.tree_util.register_pytree_node_class
+class ParamsEMAState:
+    """EMA tree + its decay (decay is static aux data, not a leaf)."""
+
+    def __init__(self, ema, decay: float):
+        self.ema = ema
+        self.decay = float(decay)
+
+    def tree_flatten(self):
+        return (self.ema,), self.decay
+
+    @classmethod
+    def tree_unflatten(cls, decay, children):
+        return cls(children[0], decay)
+
+    def __repr__(self):  # pragma: no cover - debugging nicety
+        return f"ParamsEMAState(decay={self.decay})"
+
+
+def params_ema(decay: float = 0.999) -> optax.GradientTransformation:
+    """Exponential moving average of the PARAMETERS, as a chain element.
+
+    The official SwinIR training (and most SR/diffusion recipes) evaluates
+    an EMA of the weights, not the raw weights. TPU-first this is one more
+    fused vector op per leaf inside the compiled step — not a separate
+    host-side shadow copy like the common torch ``ModelEma`` wrappers —
+    and because the EMA tree lives in the OPTIMIZER state it inherits the
+    policy's sharding (ZeRO-1+ shards it like the moments) and rides every
+    checkpoint for free.
+
+    The chain element's own value tracks ``params + update`` as seen
+    inside the chain — which is WRONG whenever the caller post-scales
+    updates (``TrainStep``'s ``lr_factor``; the Stoke facade feeds the
+    entire lr that way). Those consumers therefore overwrite it via
+    :func:`refresh_params_ema` with the EMA of the TRUE new params; the
+    chain value only stands for plain ``optax.apply_updates`` users,
+    where it is exact. Extract with :func:`ema_params`.
+    """
+
+    def init(params):
+        return ParamsEMAState(
+            ema=jax.tree.map(lambda p: p.astype(jnp.float32), params),
+            decay=decay,
+        )
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("params_ema requires update(..., params=...)")
+        new_ema = jax.tree.map(
+            lambda e, p, u: decay * e + (1.0 - decay) * (
+                p.astype(jnp.float32) + u.astype(jnp.float32)
+            ),
+            state.ema, params, updates,
+        )
+        return updates, ParamsEMAState(ema=new_ema, decay=decay)
+
+    return optax.GradientTransformation(init, update)
+
+
+def _is_ema_state(x) -> bool:
+    return isinstance(x, ParamsEMAState)
+
+
+def refresh_params_ema(prev_opt_state, new_opt_state, new_params):
+    """Recompute every :class:`ParamsEMAState` from the TRUE new params.
+
+    ``decay * prev_ema + (1-decay) * new_params`` — the correction applied
+    by TrainStep and the facade after their post-chain ``lr_factor``
+    scaling (see :func:`params_ema`). No-op when no EMA element exists.
+    """
+
+    def fix(new, old):
+        if isinstance(new, ParamsEMAState):
+            d = new.decay
+            ema = jax.tree.map(
+                lambda e, p: d * e + (1.0 - d) * p.astype(jnp.float32),
+                old.ema, new_params,
+            )
+            return ParamsEMAState(ema=ema, decay=d)
+        return new
+
+    return jax.tree.map(
+        fix, new_opt_state, prev_opt_state, is_leaf=_is_ema_state
+    )
+
+
+def ema_params(opt_state, params=None):
+    """Dig the EMA tree out of an optimizer state (tree OR fused path).
+
+    Returns the EMA pytree cast to each param leaf's dtype when ``params``
+    is given (eval-ready), else the raw f32 tree. None when no EMA is
+    being tracked. The fused path's flat EMA requires ``params`` to
+    unravel — passing none raises rather than silently returning None.
+    """
+    is_state = lambda x: isinstance(  # noqa: E731
+        x, (ParamsEMAState, FusedAdamWState)
+    )
+    found = [
+        s for s in jax.tree.leaves(opt_state, is_leaf=is_state)
+        if is_state(s)
+    ]
+    for s in found:
+        if isinstance(s, ParamsEMAState):
+            ema = s.ema
+            if params is not None:
+                ema = jax.tree.map(
+                    lambda e, p: e.astype(p.dtype), ema, params
+                )
+            return ema
+        if s.ema is not None:  # FusedAdamWState with EMA enabled
+            if params is None:
+                raise ValueError(
+                    "fused EMA is a flat buffer; pass params to unravel"
+                )
+            pflat, unravel = ravel_pytree(params)
+            return unravel(s.ema[: pflat.size].astype(pflat.dtype))
+    return None
+
+
 def adamw(
     lr: float | optax.Schedule = 1e-3,
     betas: tuple = (0.9, 0.999),
@@ -36,6 +155,7 @@ def adamw(
     weight_decay: float = 0.01,
     clip_grad_norm: float | None = None,
     clip_grad_value: float | None = None,
+    ema_decay: float | None = None,
 ) -> optax.GradientTransformation:
     """AdamW with torch-parity argument names.
 
@@ -43,6 +163,7 @@ def adamw(
     ``ClipGradNormConfig(clip=0.1)``, `Stoke-DDP.py:253,164` — torch clips
     before the step; here it's one XLA-fused chain). ``clip_grad_value``
     is the elementwise clip twin (stoke ``ClipGradConfig``).
+    ``ema_decay`` appends :func:`params_ema`.
     """
     chain = []
     if clip_grad_norm is not None:
@@ -55,6 +176,8 @@ def adamw(
             weight_decay=weight_decay,
         )
     )
+    if ema_decay is not None:
+        chain.append(params_ema(ema_decay))
     return optax.chain(*chain)
 
 
@@ -81,6 +204,7 @@ class FusedAdamWState(NamedTuple):
     count: jnp.ndarray  # i32 scalar
     mu: jnp.ndarray  # f32 [N] first moment, flat
     nu: jnp.ndarray  # f32 [N] second moment, flat
+    ema: jnp.ndarray | None = None  # f32 [N] params EMA (ema_decay set)
 
 
 class FusedAdamW:
@@ -126,6 +250,7 @@ class FusedAdamW:
         clip_grad_norm: float | None = None,
         clip_grad_value: float | None = None,
         update_wire_dtype=None,
+        ema_decay: float | None = None,
     ):
         self.lr = lr
         self.b1, self.b2 = betas
@@ -134,6 +259,9 @@ class FusedAdamW:
         self.clip_grad_norm = clip_grad_norm
         self.clip_grad_value = clip_grad_value
         self.update_wire_dtype = update_wire_dtype
+        # params EMA as ONE more full-width vector op (exact: it sees the
+        # post-lr_factor new params, unlike the tree path's chain element)
+        self.ema_decay = ema_decay
 
     # flat buffers pad to a multiple of 1024 so a ZeRO-1 mesh axis (any
     # power of two <= 1024) divides them — DeepSpeed pads its flat
@@ -146,10 +274,15 @@ class FusedAdamW:
     def init(self, params) -> FusedAdamWState:
         n = sum(x.size for x in jax.tree.leaves(params))
         n_pad = -(-n // self._PAD) * self._PAD
+        ema = None
+        if self.ema_decay is not None:
+            pflat = ravel_pytree(params)[0].astype(jnp.float32)
+            ema = jnp.pad(pflat, (0, n_pad - pflat.size))
         return FusedAdamWState(
             count=jnp.zeros([], jnp.int32),
             mu=jnp.zeros((n_pad,), jnp.float32),
             nu=jnp.zeros((n_pad,), jnp.float32),
+            ema=ema,
         )
 
     def apply(
@@ -199,16 +332,38 @@ class FusedAdamW:
             # add below upcasts back — OSS broadcast_fp16 semantics
             step_vec = step_vec.astype(self.update_wire_dtype)
         new_p32 = p32 + step_vec.astype(jnp.float32)
+        ema = opt_state.ema
+        if self.ema_decay is not None:
+            if ema is None:
+                # state from a non-EMA-configured init: silently skipping
+                # would run the whole training with a dead EMA feature
+                raise ValueError(
+                    "ema_decay is set but opt_state has no ema buffer — "
+                    "re-init the state with this optimizer (or restore a "
+                    "checkpoint written with ema_decay enabled)"
+                )
+            d = jnp.float32(self.ema_decay)
+            ema = d * ema + (1.0 - d) * new_p32
         if gate is not None:
             new_p32 = jnp.where(gate, new_p32, p32)
             mu = jnp.where(gate, mu, opt_state.mu)
             nu = jnp.where(gate, nu, opt_state.nu)
             count = jnp.where(gate, count, opt_state.count)
+            if ema is not None:
+                ema = jnp.where(gate, ema, opt_state.ema)
         return (
             unravel(new_p32[: pflat.size].astype(pflat.dtype)),
-            FusedAdamWState(count=count, mu=mu, nu=nu),
+            FusedAdamWState(count=count, mu=mu, nu=nu, ema=ema),
             gnorm,
         )
+
+    def ema_params(self, opt_state: FusedAdamWState, params):
+        """Unravel the flat EMA into a params-shaped, params-dtyped tree
+        (eval-ready). None when ``ema_decay`` was not set."""
+        if opt_state.ema is None:
+            return None
+        pflat, unravel = ravel_pytree(params)
+        return unravel(opt_state.ema[: pflat.size].astype(pflat.dtype))
 
     def apply_tree(
         self,

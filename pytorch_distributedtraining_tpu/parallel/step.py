@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..optim import FusedAdamW
+from ..optim import FusedAdamW, refresh_params_ema
 from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
 from ..runtime.mesh import batch_spec
 from .policy import Policy
@@ -285,6 +285,11 @@ class TrainStep:
                     lambda u: u.astype(self.update_wire_dtype), updates
                 )
             new_params = optax.apply_updates(state.params, updates)
+            # params-EMA correction: the chain element saw pre-lr_factor
+            # updates; recompute from the TRUE new params (optim.params_ema)
+            new_opt = refresh_params_ema(
+                state.opt_state, new_opt, new_params
+            )
 
             if self.loss_scaler is not None:
                 # skip the whole update on overflow (GradScaler semantics)

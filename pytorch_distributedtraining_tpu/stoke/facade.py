@@ -708,6 +708,11 @@ class Stoke:
                     lambda u: u.astype(wire_dtype), updates
                 )
             new_params = jax.tree.map(lambda p, u: p + u, params, updates)
+            # params-EMA correction: lr rides THIS post-chain multiply, so
+            # the chain element's own EMA tracked lr=1.0-magnitude steps
+            new_opt = optim_mod.refresh_params_ema(
+                opt_state, new_opt, new_params
+            )
             if scaler is not None:
                 new_params = jax.tree.map(
                     lambda n, o: jnp.where(finite, n, o), new_params, params

@@ -1,0 +1,159 @@
+"""Parameter EMA: optax chain element + FusedAdamW flat buffer.
+
+The official SwinIR recipe evaluates an EMA of the weights; here the EMA
+lives in optimizer state (sharded by the policy, checkpointed for free)
+and updates inside the compiled step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributedtraining_tpu import optim
+from pytorch_distributedtraining_tpu.losses import mse_loss
+from pytorch_distributedtraining_tpu.models import Net
+from pytorch_distributedtraining_tpu.parallel import (
+    ZeRO1,
+    TrainStep,
+    create_train_state,
+)
+from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
+
+DECAY = 0.5  # fast decay so 3 steps move the EMA measurably
+
+
+def _params():
+    return {"w": jnp.asarray([1.0, -2.0, 3.0]), "b": jnp.asarray([0.5])}
+
+
+def _grads():
+    return {"w": jnp.asarray([0.1, 0.2, -0.1]), "b": jnp.asarray([0.05])}
+
+
+def test_tree_ema_tracks_updates():
+    tx = optim.adamw(lr=1e-2, ema_decay=DECAY)
+    params = _params()
+    state = tx.init(params)
+    ema_ref = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+    for _ in range(3):
+        updates, state = tx.update(_grads(), state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        ema_ref = jax.tree.map(
+            lambda e, p: DECAY * e + (1 - DECAY) * p, ema_ref, params
+        )
+    got = optim.ema_params(state, params)
+    assert got is not None
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(ema_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_ema_params_none_without_element():
+    tx = optim.adamw(lr=1e-2)
+    state = tx.init(_params())
+    assert optim.ema_params(state) is None
+
+
+def test_fused_ema_matches_tree():
+    params = _params()
+    tx_t = optim.adamw(lr=1e-2, ema_decay=DECAY)
+    tx_f = optim.FusedAdamW(lr=1e-2, ema_decay=DECAY)
+    st_t, st_f = tx_t.init(params), tx_f.init(params)
+    p_t = p_f = params
+    for _ in range(3):
+        updates, st_t = tx_t.update(_grads(), st_t, p_t)
+        p_t = jax.tree.map(lambda p, u: p + u, p_t, updates)
+        gflat = jax.flatten_util.ravel_pytree(_grads())[0]
+        p_f, st_f, _ = tx_f.apply(gflat, st_f, p_f)
+    for a, b in zip(
+        jax.tree.leaves(tx_f.ema_params(st_f, p_f)),
+        jax.tree.leaves(optim.ema_params(st_t, p_t)),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+    # raw params agree too (same formulas)
+    for a, b in zip(jax.tree.leaves(p_f), jax.tree.leaves(p_t)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_tree_ema_exact_under_lr_factor(devices8):
+    """The consumer-side refresh: with updates post-scaled by lr_factor
+    (the facade feeds the WHOLE lr that way), the EMA must track the true
+    new params, not the chain-internal lr=1.0 step."""
+    from pytorch_distributedtraining_tpu.parallel import DDP
+
+    mesh = make_mesh(MeshSpec.ddp(8), devices=devices8)
+    model = Net(upscale_factor=2)
+    tx = optim.adamw(lr=1.0, ema_decay=DECAY)  # facade-style: lr via factor
+    policy = DDP()
+
+    def loss_fn(params, batch, rng, ms):
+        lo, hr = batch
+        return mse_loss(model.apply({"params": params}, lo), hr), {}
+
+    state, sh = create_train_state(
+        init_fn=lambda r: (
+            model.init(r, jnp.zeros((1, 8, 8, 3)))["params"], {},
+        ),
+        tx=tx, mesh=mesh, policy=policy,
+    )
+    step = TrainStep(
+        loss_fn, tx, mesh, policy, state_shardings=sh, donate=False
+    )
+    rng = np.random.default_rng(0)
+    hr = rng.random((16, 16, 16, 3)).astype(np.float32)
+    lo = hr.reshape(16, 8, 2, 8, 2, 3).mean(axis=(2, 4))
+    prev_params = state.params
+    ema_ref = jax.tree.map(lambda p: p.astype(jnp.float32), prev_params)
+    with mesh:
+        for _ in range(3):
+            state, _ = step(state, (lo, hr), lr_factor=1e-3)
+            ema_ref = jax.tree.map(
+                lambda e, p: DECAY * e + (1 - DECAY) * p,
+                ema_ref, state.params,
+            )
+    got = optim.ema_params(state.opt_state)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(ema_ref)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
+    # and the EMA is NOT the garbage lr=1.0 track: it stays within the
+    # small neighborhood the 1e-3-scaled steps define
+    flat_p = jax.flatten_util.ravel_pytree(state.params)[0]
+    flat_e = jax.flatten_util.ravel_pytree(got)[0]
+    assert float(jnp.max(jnp.abs(flat_p - flat_e))) < 0.5
+
+
+def test_fused_ema_shards_under_zero1(devices8):
+    mesh = make_mesh(MeshSpec.zero(8), devices=devices8)
+    model = Net(upscale_factor=2)
+    tx = optim.FusedAdamW(lr=1e-3, ema_decay=0.99)
+    policy = ZeRO1(min_shard_size=1)
+
+    def loss_fn(params, batch, rng, ms):
+        lo, hr = batch
+        return mse_loss(model.apply({"params": params}, lo), hr), {}
+
+    state, sh = create_train_state(
+        init_fn=lambda r: (
+            model.init(r, jnp.zeros((1, 8, 8, 3)))["params"], {},
+        ),
+        tx=tx, mesh=mesh, policy=policy,
+    )
+    step = TrainStep(
+        loss_fn, tx, mesh, policy, state_shardings=sh, donate=False
+    )
+    rng = np.random.default_rng(0)
+    hr = rng.random((16, 16, 16, 3)).astype(np.float32)
+    lo = hr.reshape(16, 8, 2, 8, 2, 3).mean(axis=(2, 4))
+    with mesh:
+        for _ in range(2):
+            state, m = step(state, (lo, hr))
+    ema_flat = state.opt_state.ema
+    # the flat EMA shards over the axis exactly like the moments
+    assert ema_flat.addressable_shards[0].data.size < ema_flat.size
+    ema_tree = tx.ema_params(state.opt_state, state.params)
+    # EMA moved off the raw params but stays close after 2 steps
+    flat_p = jax.flatten_util.ravel_pytree(state.params)[0]
+    flat_e = jax.flatten_util.ravel_pytree(ema_tree)[0]
+    diff = float(jnp.max(jnp.abs(flat_p - flat_e)))
+    assert 0.0 < diff < 0.1
+    assert np.isfinite(float(m["loss"]))
