@@ -344,6 +344,15 @@ class FusedAdamW:
                 )
             d = jnp.float32(self.ema_decay)
             ema = d * ema + (1.0 - d) * new_p32
+        elif ema is not None:
+            # mirror of the guard above: an EMA'd state driven by a
+            # non-EMA optimizer would silently freeze the EMA while
+            # ema_params() keeps serving it as live
+            raise ValueError(
+                "opt_state carries an ema buffer but this optimizer has "
+                "ema_decay=None — construct FusedAdamW(ema_decay=...) to "
+                "keep maintaining it (or re-init the state without EMA)"
+            )
         if gate is not None:
             new_p32 = jnp.where(gate, new_p32, p32)
             mu = jnp.where(gate, mu, opt_state.mu)
@@ -362,8 +371,7 @@ class FusedAdamW:
         (eval-ready). None when ``ema_decay`` was not set."""
         if opt_state.ema is None:
             return None
-        pflat, unravel = ravel_pytree(params)
-        return unravel(opt_state.ema[: pflat.size].astype(pflat.dtype))
+        return ema_params(opt_state, params)
 
     def apply_tree(
         self,

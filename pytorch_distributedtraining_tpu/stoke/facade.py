@@ -1328,6 +1328,22 @@ class Stoke:
     def step_count(self) -> int:
         return 0 if self._state is None else int(self._state.step)
 
+    @property
+    def ema_params(self):
+        """Eval-ready params-EMA tree, or None when no EMA is tracked.
+
+        Enable via ``optimizer_kwargs={'ema_decay': 0.999}`` (works on
+        both the auto-selected fused path and the per-leaf chain); the
+        EMA updates inside the compiled step and shards/checkpoints with
+        the optimizer state. Evaluate with
+        ``model.apply({'params': stoke_model.ema_params}, x)``.
+        """
+        if self._state is None:
+            return None
+        return optim_mod.ema_params(
+            self._state.opt_state, self._state.params
+        )
+
     def print_on_devices(self, msg: str = ""):
         """Rank-stamped print (`Stoke-DDP.py:67,130`)."""
         print(f"[rank {self.rank}/{self.world_size}] {msg}", flush=True)

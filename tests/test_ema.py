@@ -157,3 +157,47 @@ def test_fused_ema_shards_under_zero1(devices8):
     diff = float(jnp.max(jnp.abs(flat_p - flat_e)))
     assert 0.0 < diff < 0.1
     assert np.isfinite(float(m["loss"]))
+
+
+def test_facade_ema_property(devices8):
+    """ema_decay flows through StokeOptimizer kwargs on both layouts."""
+    from pytorch_distributedtraining_tpu.stoke import (
+        DistributedOptions,
+        Stoke,
+        StokeOptimizer,
+    )
+
+    def build(**flags):
+        return Stoke(
+            model=Net(upscale_factor=2),
+            verbose=False,
+            optimizer=StokeOptimizer(
+                optimizer="AdamW",
+                optimizer_kwargs={"lr": 1e-3, "ema_decay": 0.9},
+            ),
+            loss=mse_loss,
+            batch_size_per_device=2,
+            gpu=True,
+            fp16=None,
+            distributed=DistributedOptions.ddp.value,
+            **flags,
+        )
+
+    rng = np.random.default_rng(0)
+    hr = rng.random((8, 16, 16, 3)).astype(np.float32)
+    lo = hr.reshape(8, 8, 2, 8, 2, 3).mean(axis=(2, 4))
+    # fused auto-selected (DDP) and per-leaf chain (ZeRO-2) both track EMA
+    for flags in ({}, {"fairscale_oss": True, "fairscale_sddp": True}):
+        sm = build(**flags)
+        assert sm.ema_params is None  # no state yet
+        for _ in range(2):
+            out = sm.model(lo)
+            loss = sm.loss(out, hr)
+            sm.backward(loss)
+            sm.step()
+        ema = sm.ema_params
+        assert ema is not None
+        flat_p = jax.flatten_util.ravel_pytree(sm.state.params)[0]
+        flat_e = jax.flatten_util.ravel_pytree(ema)[0]
+        d = float(jnp.max(jnp.abs(flat_p - flat_e)))
+        assert 0.0 < d < 0.5, f"EMA diverged or dead ({flags}): {d}"
