@@ -105,6 +105,23 @@ restored = checkpoint_sharded.restore_sharded(ckpt, state.params)
 for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored)):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+# ---- async preemption agreement: SIGTERM lands on ONE rank only ----------
+# every rank must (a) take the same save branch via the allgather and
+# (b) block until its shards are durable — the non-signalled ranks dying
+# mid-background-write is the failure mode being pinned here
+from pytorch_distributedtraining_tpu.checkpoint_sharded import CheckpointManager
+
+mgr = CheckpointManager(
+    os.environ["CKPT_DIR"] + "_mgr", save_every=10_000, keep=2,
+    handle_sigterm=False, async_save=True,
+)
+if rank == 0:
+    mgr._preempted.set()  # simulated scheduler signal, this host only
+p = mgr.maybe_save(7, state.params)
+assert p is not None, "non-signalled rank must join the agreed save"
+assert mgr.latest_step() == 7, "preemption save must be durable on return"
+mgr.close()
+
 # process barrier via the coordination service (ops.barrier multi-proc path)
 from pytorch_distributedtraining_tpu.ops import barrier
 barrier("end_of_child")
