@@ -14,6 +14,7 @@ from .dataset import (
 )
 from .sampler import DistributedSampler
 from .loader import DataLoader, stack_windows
+from .transforms import PairedRandomAug
 
 __all__ = [
     "Dataset",
@@ -25,4 +26,5 @@ __all__ = [
     "DistributedSampler",
     "DataLoader",
     "stack_windows",
+    "PairedRandomAug",
 ]

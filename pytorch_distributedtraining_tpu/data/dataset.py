@@ -113,7 +113,8 @@ class CustomDataset(Dataset):
     ``(input_HWC, target_HWC)`` float32 in [0,1].
     """
 
-    def __init__(self, input_path: str, target_path: str):
+    def __init__(self, input_path: str, target_path: str, transform=None):
+        self.transform = transform  # e.g. transforms.PairedRandomAug
         self.input_files = _list_images(input_path)
         self.target_files = _list_images(target_path)
         if len(self.input_files) != len(self.target_files):
@@ -134,7 +135,11 @@ class CustomDataset(Dataset):
         return len(self.input_files)
 
     def __getitem__(self, idx):
-        return _load_image(self.input_files[idx]), _load_image(self.target_files[idx])
+        lr = _load_image(self.input_files[idx])
+        hr = _load_image(self.target_files[idx])
+        if self.transform is not None:
+            lr, hr = self.transform(lr, hr, idx)
+        return lr, hr
 
 
 class PatchStore(Dataset):
@@ -154,7 +159,8 @@ class PatchStore(Dataset):
 
     LR_NAME, HR_NAME = "lr.npy", "hr.npy"
 
-    def __init__(self, store_dir: str):
+    def __init__(self, store_dir: str, transform=None):
+        self.transform = transform  # e.g. transforms.PairedRandomAug
         self.store_dir = store_dir
         lr_path = os.path.join(store_dir, self.LR_NAME)
         hr_path = os.path.join(store_dir, self.HR_NAME)
@@ -213,14 +219,15 @@ class PatchStore(Dataset):
         # fused u8 -> f32/255 via the C++ kernel (mean 0, std 1);
         # n_threads=1: loader workers already parallelize across samples,
         # spawning threads per few-KB patch would oversubscribe the host
-        return (
-            csrc.normalize_u8(
-                np.asarray(self._lr[idx]), mean=0.0, std=1.0, n_threads=1
-            ),
-            csrc.normalize_u8(
-                np.asarray(self._hr[idx]), mean=0.0, std=1.0, n_threads=1
-            ),
+        lr = csrc.normalize_u8(
+            np.asarray(self._lr[idx]), mean=0.0, std=1.0, n_threads=1
         )
+        hr = csrc.normalize_u8(
+            np.asarray(self._hr[idx]), mean=0.0, std=1.0, n_threads=1
+        )
+        if self.transform is not None:
+            lr, hr = self.transform(lr, hr, idx)
+        return lr, hr
 
 
 class SyntheticSRDataset(Dataset):

@@ -164,6 +164,8 @@ class DataLoader:
         self._mp_context = None if ctx == "thread" else ctx
         self.persistent_workers = bool(persistent_workers)
         self._pool = None  # live persistent executor, if any
+        self._forwarded_epoch = None  # last epoch pushed to the transform
+        self._pool_built_epoch = None  # transform epoch a live pool pickled
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -190,6 +192,25 @@ class DataLoader:
         self._explicit_epoch = True
         if self.sampler is not None:
             self.sampler.set_epoch(epoch)
+        self._sync_transform_epoch()
+
+    def _sync_transform_epoch(self) -> None:
+        """Forward the loader's epoch to an epoch-aware dataset transform.
+
+        The sampler's forgotten-``set_epoch`` bug class applies equally to
+        augmentation (`data/transforms.py`): without this plumbing every
+        epoch replays epoch-0 crops. A persistent process pool pickled the
+        dataset (transform included) at pool creation, so when the epoch
+        moved, the pool restarts at the next build — correctness over
+        worker reuse, and only when an epoch-aware transform is present.
+        """
+        tf = getattr(self.dataset, "transform", None)
+        if tf is None or not hasattr(tf, "set_epoch"):
+            return
+        tf.set_epoch(self._epoch)
+        self._forwarded_epoch = self._epoch
+        if self._pool is not None and self._pool_built_epoch != self._epoch:
+            self.shutdown_workers()
 
     def _index_batches(self):
         if self.sampler is not None:
@@ -238,6 +259,9 @@ class DataLoader:
         return jax.tree.map(place, batch)
 
     def __iter__(self):
+        # the transform must see THIS epoch before the auto bump below
+        # (fetches run lazily, after the bump has already moved _epoch)
+        self._sync_transform_epoch()
         # snapshot the index order NOW (generators run lazily; the epoch
         # bump below must not leak into this epoch's shuffle)
         batches = list(self._index_batches())
@@ -316,6 +340,7 @@ class DataLoader:
         )
         if self.persistent_workers:
             self._pool = pool
+            self._pool_built_epoch = self._forwarded_epoch
         return pool, _process_worker_fetch, self.persistent_workers
 
     def shutdown_workers(self):

@@ -1,0 +1,113 @@
+"""Paired SR augmentation: crop/flip/rot90 that keeps LR↔HR aligned.
+
+The reference trains on pre-cropped fixed patches
+(`/root/reference/Stoke-DDP.py:169-170`) with no augmentation; standard SR
+recipes (incl. the official SwinIR training) add random paired crops and
+dihedral flips. The transform here is:
+
+- **pairing-preserving**: the LR window and the HR window cover the same
+  image content (HR coords = LR coords × scale), and flips/rotations act
+  identically on both — so an exact ``scale×scale`` box-downsample
+  relation between the pair survives augmentation bit-for-bit
+  (``tests/test_transforms.py`` asserts it).
+- **deterministic**: draws are seeded by ``(seed, epoch, idx)``, so a
+  resumed epoch reproduces the same crops on every rank and worker; call
+  ``set_epoch`` per epoch like the sampler (the reference's forgotten
+  ``set_epoch`` bug class, fixed at the sampler level in
+  `data/sampler.py`, applies here too).
+
+Works host-side on numpy HWC samples (augmentation belongs in the input
+pipeline, not the compiled step — data-dependent shapes would retrace).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class PairedRandomAug:
+    """Random paired crop + dihedral augmentation for (lr, hr) samples.
+
+    Args:
+        scale: HR/LR size ratio (the SR upscale factor).
+        crop_lr: LR-space crop size; None keeps full size (no crop).
+        hflip / vflip / rot90: enable the respective random transforms.
+        seed: base seed for the per-``(epoch, idx)`` draws.
+
+    Use as a dataset ``transform``::
+
+        ds = CustomDataset(in_dir, tgt_dir,
+                           transform=PairedRandomAug(scale=2, crop_lr=48))
+        ...
+        for epoch in range(E):
+            ds.transform.set_epoch(epoch)
+    """
+
+    def __init__(
+        self,
+        scale: int = 2,
+        crop_lr: int | None = None,
+        hflip: bool = True,
+        vflip: bool = False,
+        rot90: bool = True,
+        seed: int = 0,
+    ):
+        self.scale = int(scale)
+        if crop_lr is not None and int(crop_lr) < 1:
+            # 0/negative would pass the per-call bounds check and emit
+            # empty arrays that crash far away in collate or the model
+            raise ValueError(f"crop_lr must be >= 1, got {crop_lr}")
+        self.crop_lr = crop_lr
+        self.hflip = hflip
+        self.vflip = vflip
+        self.rot90 = rot90
+        self.seed = int(seed)
+        self._epoch = 0
+        self._warned_rot90 = False
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
+
+    def __call__(self, lr: np.ndarray, hr: np.ndarray, idx: int = 0):
+        s = self.scale
+        if hr.shape[0] != lr.shape[0] * s or hr.shape[1] != lr.shape[1] * s:
+            raise ValueError(
+                f"hr {hr.shape[:2]} is not lr {lr.shape[:2]} x{s}"
+            )
+        rng = np.random.default_rng((self.seed, self._epoch, int(idx)))
+        if self.crop_lr is not None:
+            c = int(self.crop_lr)
+            if c > min(lr.shape[0], lr.shape[1]):
+                raise ValueError(
+                    f"crop_lr={c} exceeds lr size {lr.shape[:2]}"
+                )
+            y = int(rng.integers(0, lr.shape[0] - c + 1))
+            x = int(rng.integers(0, lr.shape[1] - c + 1))
+            lr = lr[y : y + c, x : x + c]
+            hr = hr[y * s : (y + c) * s, x * s : (x + c) * s]
+        if self.hflip and rng.random() < 0.5:
+            lr, hr = lr[:, ::-1], hr[:, ::-1]
+        if self.vflip and rng.random() < 0.5:
+            lr, hr = lr[::-1], hr[::-1]
+        if self.rot90:
+            if lr.shape[0] == lr.shape[1]:
+                k = int(rng.integers(0, 4))
+                if k:
+                    lr = np.rot90(lr, k, axes=(0, 1))
+                    hr = np.rot90(hr, k, axes=(0, 1))
+            elif not self._warned_rot90:
+                # silently-inert augmentation is worse than none: say so
+                # once (raising would forbid flips-only use on full frames)
+                import warnings
+
+                self._warned_rot90 = True
+                warnings.warn(
+                    f"rot90 requested but sample is non-square "
+                    f"{lr.shape[:2]} — rotation skipped (pass rot90=False "
+                    "or crop_lr=<square size> to silence)",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        # contiguous copies: downstream collate memcpy (csrc fast_stack)
+        # and device_put want dense buffers, not reversed-stride views
+        return np.ascontiguousarray(lr), np.ascontiguousarray(hr)

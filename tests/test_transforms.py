@@ -1,0 +1,151 @@
+"""PairedRandomAug: pairing preservation, determinism, epoch variation."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributedtraining_tpu.data import (
+    PairedRandomAug,
+    SyntheticSRDataset,
+)
+
+
+def _pair(lr_size=16, scale=2, seed=0):
+    return SyntheticSRDataset(n=4, lr_size=lr_size, scale=scale, seed=seed)[1]
+
+
+def _downsample(hr, s):
+    h, w, c = hr.shape
+    return hr.reshape(h // s, s, w // s, s, c).mean(axis=(1, 3))
+
+
+@pytest.mark.parametrize("crop", [None, 8])
+def test_pairing_survives_augmentation(crop):
+    """The exact box-downsample relation holds bit-for-bit after aug —
+    crop windows align across scales and flips/rot90 commute."""
+    lr, hr = _pair()
+    aug = PairedRandomAug(scale=2, crop_lr=crop, vflip=True, seed=3)
+    for epoch in range(3):
+        aug.set_epoch(epoch)
+        for idx in range(5):
+            la, ha = aug(lr, hr, idx)
+            if crop is not None:
+                assert la.shape == (crop, crop, 3)
+                assert ha.shape == (2 * crop, 2 * crop, 3)
+            np.testing.assert_allclose(
+                la, _downsample(ha, 2), rtol=1e-6, atol=1e-7
+            )
+
+
+def test_deterministic_per_epoch_idx():
+    lr, hr = _pair()
+    a = PairedRandomAug(scale=2, crop_lr=8, seed=5)
+    b = PairedRandomAug(scale=2, crop_lr=8, seed=5)
+    a.set_epoch(2)
+    b.set_epoch(2)
+    la, ha = a(lr, hr, 7)
+    lb, hb = b(lr, hr, 7)
+    np.testing.assert_array_equal(la, lb)
+    np.testing.assert_array_equal(ha, hb)
+    # a different epoch draws a different crop (overwhelmingly likely on
+    # a 16->8 crop grid with flips; fixed seeds make this deterministic)
+    b.set_epoch(3)
+    lc, _ = b(lr, hr, 7)
+    assert not np.array_equal(la, lc)
+
+
+def test_shape_mismatch_rejected():
+    lr, hr = _pair()
+    aug = PairedRandomAug(scale=4)  # wrong scale for an x2 pair
+    with pytest.raises(ValueError, match="x4"):
+        aug(lr, hr, 0)
+    with pytest.raises(ValueError, match="exceeds"):
+        PairedRandomAug(scale=2, crop_lr=64)(lr, hr, 0)
+
+
+def test_dataset_integration():
+    ds = SyntheticSRDataset(n=4, lr_size=16, scale=2)
+    lr, hr = ds[0]
+    aug = PairedRandomAug(scale=2, crop_lr=8, seed=1)
+    la, ha = aug(lr, hr, 0)
+    assert la.flags["C_CONTIGUOUS"] and ha.flags["C_CONTIGUOUS"]
+    # CustomDataset/PatchStore take transform=...; SyntheticSRDataset is
+    # exercised through the callable directly (it has no ctor arg)
+    np.testing.assert_allclose(la, _downsample(ha, 2), rtol=1e-6, atol=1e-7)
+
+
+def test_loader_forwards_epoch_to_transform():
+    """The loader's epoch plumbing reaches the transform — explicit
+    set_epoch and the auto bump both (the sampler's forgotten-set_epoch
+    bug class, closed for augmentation too)."""
+    from pytorch_distributedtraining_tpu.data import DataLoader
+
+    class _Tf:
+        def __init__(self):
+            self.seen = []
+
+        def set_epoch(self, e):
+            self.seen.append(e)
+
+        def __call__(self, lr, hr, idx=0):
+            return lr, hr
+
+    ds = SyntheticSRDataset(n=8, lr_size=8, scale=2)
+    ds.transform = _Tf()  # duck-typed: loader looks for .transform
+    loader = DataLoader(ds, batch_size=4)
+    loader.set_epoch(5)
+    assert ds.transform.seen[-1] == 5
+    list(loader)  # iter syncs current epoch before fetches
+    assert ds.transform.seen[-1] == 5
+
+
+class _EpochStampTf:
+    """Stamps each sample with the transform's current epoch (picklable
+    at module level: spawn workers re-import this module)."""
+
+    def __init__(self):
+        self._epoch = 0
+
+    def set_epoch(self, e):
+        self._epoch = e
+
+    def __call__(self, lr, hr, idx=0):
+        return lr + self._epoch, hr
+
+
+class _StampDS:
+    """Dataset applying an epoch-aware transform (what CustomDataset and
+    PatchStore do internally), module-level for spawn pickling."""
+
+    def __init__(self, n=8):
+        self.n = n
+        self.transform = _EpochStampTf()
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        lr = np.zeros((4, 4, 3), np.float32)
+        hr = np.zeros((8, 8, 3), np.float32)
+        return self.transform(lr, hr, i)
+
+
+def test_persistent_pool_restarts_on_epoch_change(tmp_path):
+    """Workers pickled the transform at pool creation; an epoch change
+    must restart the pool so augmentation doesn't replay epoch 0."""
+    from pytorch_distributedtraining_tpu.data import DataLoader
+
+    loader = DataLoader(
+        _StampDS(), batch_size=4, num_workers=2,
+        multiprocessing_context="spawn", persistent_workers=True,
+    )
+    try:
+        loader.set_epoch(0)
+        (lr0, _), = [b for b in loader][:1]
+        loader.set_epoch(3)
+        (lr3, _), = [b for b in loader][:1]
+        assert float(np.asarray(lr0).max()) == 0.0
+        assert float(np.asarray(lr3).min()) == 3.0, (
+            "worker pool served epoch-0 transform after set_epoch(3)"
+        )
+    finally:
+        loader.shutdown_workers()
