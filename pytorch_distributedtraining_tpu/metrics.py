@@ -1,4 +1,4 @@
-"""Image-quality metrics: MAE + PSNR.
+"""Image-quality metrics: MAE + PSNR + SSIM.
 
 Twin of the reference's missing ``metrics.py`` module
 (`/root/reference/Stoke-DDP.py:38,120-121`; `Fairscale-DDP.py:17`): the
@@ -29,3 +29,49 @@ def psnr(outputs, targets, data_range: float = 1.0):
     err = mse(outputs, targets)
     err = jnp.maximum(err, jnp.finfo(jnp.float32).tiny)  # inf-guard
     return 10.0 * jnp.log10(data_range**2 / err)
+
+
+def ssim(outputs, targets, data_range: float = 1.0):
+    """Structural similarity (Wang et al. 2004): 11x11 gaussian window
+    (sigma 1.5), K1=0.01/K2=0.03 — the standard SR eval companion to PSNR.
+
+    Accepts HWC or NHWC [0, data_range] images; returns the mean SSIM over
+    all windows/channels as a device scalar (fits ``eval_step`` metric
+    fns). Channels are compared independently (depthwise windows), the
+    common RGB convention.
+    """
+    import jax
+
+    x = jnp.asarray(outputs, jnp.float32)
+    y = jnp.asarray(targets, jnp.float32)
+    if x.ndim != y.ndim or x.shape != y.shape:
+        # a silent broadcast here would die later inside the conv with an
+        # opaque dimension_numbers error
+        raise ValueError(f"shape mismatch: {x.shape} vs {y.shape}")
+    if x.ndim == 3:
+        x, y = x[None], y[None]
+    if x.shape[1] < 11 or x.shape[2] < 11:
+        raise ValueError(f"ssim needs >=11x11 images, got {x.shape[1:3]}")
+    coords = jnp.arange(11, dtype=jnp.float32) - 5.0
+    g = jnp.exp(-(coords**2) / (2.0 * 1.5**2))
+    g = g / jnp.sum(g)
+    c = x.shape[-1]
+    kern = jnp.tile(jnp.outer(g, g)[:, :, None, None], (1, 1, 1, c))
+
+    def win(t):  # depthwise 11x11 gaussian mean per channel
+        return jax.lax.conv_general_dilated(
+            t, kern, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=c,
+        )
+
+    mu_x, mu_y = win(x), win(y)
+    var_x = win(x * x) - mu_x * mu_x
+    var_y = win(y * y) - mu_y * mu_y
+    cov = win(x * y) - mu_x * mu_y
+    c1 = (0.01 * data_range) ** 2
+    c2 = (0.03 * data_range) ** 2
+    s = ((2.0 * mu_x * mu_y + c1) * (2.0 * cov + c2)) / (
+        (mu_x * mu_x + mu_y * mu_y + c1) * (var_x + var_y + c2)
+    )
+    return jnp.mean(s)

@@ -1384,7 +1384,9 @@ class Stoke:
 
         barrier()
 
-    def eval_step(self, metric_fns: dict | None = None) -> Callable:
+    def eval_step(
+        self, metric_fns: dict | None = None, use_ema: bool = False
+    ) -> Callable:
         """Policy-aware compiled validation step (VERDICT r3 weak #7).
 
         Returns ``step(inputs, targets) -> dict`` of device scalars:
@@ -1394,9 +1396,20 @@ class Stoke:
         data axes). Results stay on device so the caller can accumulate
         across batches and pay one host sync per epoch, unlike the
         reference's per-batch ``float()`` loop (`Stoke-DDP.py:114-121`).
+
+        ``use_ema=True`` evaluates the tracked params EMA (see
+        :attr:`ema_params`) instead of the raw weights — the standard SR
+        eval protocol when ``ema_decay`` is on.
         """
         self._require_state()
         metric_fns = dict(metric_fns or {})
+        if use_ema and self.ema_params is None:
+            # whether an EMA is tracked is fixed at optimizer
+            # construction — fail at build, not on the first batch
+            raise ValueError(
+                "use_ema=True but no EMA is tracked — pass "
+                "optimizer_kwargs={'ema_decay': ...}"
+            )
         # keyed by fn identity AND the current shardings object: a re-init
         # (new mesh/policy) must not replay a step jitted against stale
         # in_shardings. Bounded: fresh lambdas per epoch would otherwise
@@ -1404,6 +1417,7 @@ class Stoke:
         key = (
             tuple(sorted((name, id(fn)) for name, fn in metric_fns.items())),
             id(self._shardings),
+            bool(use_ema),
         )
         cached = getattr(self, "_eval_steps", None)
         if cached is None:
@@ -1413,26 +1427,58 @@ class Stoke:
         if len(cached) >= 8:
             cached.pop(next(iter(cached)))  # evict oldest
 
-        from ..parallel.step import EvalStep
+        # one compiled program serves both the raw and EMA wrappers
+        # (use_ema only changes which params tree is fed)
+        inners = getattr(self, "_eval_inners", None)
+        if inners is None:
+            inners = self._eval_inners = {}
+        ikey = key[:2]
+        inner = inners.get(ikey)
+        if inner is None:
+            from ..parallel.step import EvalStep
 
-        precision = self.precision
-        loss_callable = self._loss_callable
+            precision = self.precision
+            loss_callable = self._loss_callable
 
-        def eval_fn(params, batch, model_state):
-            x, y = batch
-            pc = precision.cast_to_compute(params)
-            out, _ = self._apply_model(pc, model_state, x, train=False, rng=None)
-            out = precision.cast_to_output(out)
-            result = {"loss": loss_callable(out, y)}
-            for name, fn in metric_fns.items():
-                result[name] = fn(out, y)
-            return result
+            def eval_fn(params, batch, model_state):
+                x, y = batch
+                pc = precision.cast_to_compute(params)
+                out, _ = self._apply_model(
+                    pc, model_state, x, train=False, rng=None
+                )
+                out = precision.cast_to_output(out)
+                result = {"loss": loss_callable(out, y)}
+                for name, fn in metric_fns.items():
+                    result[name] = fn(out, y)
+                return result
 
-        inner = EvalStep(eval_fn, self.mesh, state_shardings=self._shardings)
+            inner = EvalStep(
+                eval_fn, self.mesh, state_shardings=self._shardings
+            )
+            if len(inners) >= 8:
+                inners.pop(next(iter(inners)))
+            inners[ikey] = inner
+
+        # EMA extraction is opt_state-fixed for a whole validation epoch:
+        # memoize per state identity, and place the tree on the DECLARED
+        # param shardings so the jitted step never reshards per batch
+        # (and host-offloaded layouts keep their memory kind)
+        ema_cache: dict = {"key": None, "tree": None}
 
         def step(inputs, targets):
+            st = self._state
+            if use_ema:
+                k = id(st.opt_state)
+                if ema_cache["key"] != k:
+                    ep = self.ema_params
+                    ep = jax.tree.map(
+                        lambda e, s: jax.device_put(e, s),
+                        ep, self._shardings.params,
+                    )
+                    ema_cache["key"], ema_cache["tree"] = k, ep
+                st = st.replace(params=ema_cache["tree"])
             batch = (self._shard_batch(inputs), self._shard_batch(targets))
-            return inner(self._state, batch)
+            return inner(st, batch)
 
         cached[key] = step
         return step

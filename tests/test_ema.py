@@ -8,6 +8,7 @@ and updates inside the compiled step.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from pytorch_distributedtraining_tpu import optim
 from pytorch_distributedtraining_tpu.losses import mse_loss
@@ -201,3 +202,65 @@ def test_facade_ema_property(devices8):
         flat_e = jax.flatten_util.ravel_pytree(ema)[0]
         d = float(jnp.max(jnp.abs(flat_p - flat_e)))
         assert 0.0 < d < 0.5, f"EMA diverged or dead ({flags}): {d}"
+
+
+def test_facade_eval_step_on_ema(devices8):
+    from pytorch_distributedtraining_tpu import metrics
+    from pytorch_distributedtraining_tpu.stoke import (
+        DistributedOptions,
+        Stoke,
+        StokeOptimizer,
+    )
+
+    sm = Stoke(
+        model=Net(upscale_factor=2),
+        verbose=False,
+        optimizer=StokeOptimizer(
+            optimizer="AdamW",
+            optimizer_kwargs={"lr": 5e-2, "ema_decay": 0.5},
+        ),
+        loss=mse_loss,
+        batch_size_per_device=2,
+        gpu=True,
+        fp16=None,
+        distributed=DistributedOptions.ddp.value,
+    )
+    rng = np.random.default_rng(0)
+    hr = rng.random((8, 16, 16, 3)).astype(np.float32)
+    lo = hr.reshape(8, 8, 2, 8, 2, 3).mean(axis=(2, 4))
+    for _ in range(3):
+        sm.backward(sm.loss(sm.model(lo), hr))
+        sm.step()
+    raw = sm.eval_step({"psnr": metrics.psnr})(lo, hr)
+    ema = sm.eval_step({"psnr": metrics.psnr}, use_ema=True)(lo, hr)
+    # big lr + fast decay: raw and EMA weights measurably disagree
+    assert float(raw["loss"]) != float(ema["loss"])
+    assert np.isfinite(float(ema["psnr"]))
+
+
+def test_facade_eval_step_use_ema_requires_tracking(devices8):
+    from pytorch_distributedtraining_tpu.stoke import (
+        DistributedOptions,
+        Stoke,
+        StokeOptimizer,
+    )
+
+    sm = Stoke(
+        model=Net(upscale_factor=2),
+        verbose=False,
+        optimizer=StokeOptimizer(
+            optimizer="AdamW", optimizer_kwargs={"lr": 1e-3},
+        ),
+        loss=mse_loss,
+        batch_size_per_device=2,
+        gpu=True,
+        fp16=None,
+        distributed=DistributedOptions.ddp.value,
+    )
+    rng = np.random.default_rng(0)
+    hr = rng.random((8, 16, 16, 3)).astype(np.float32)
+    lo = hr.reshape(8, 8, 2, 8, 2, 3).mean(axis=(2, 4))
+    sm.backward(sm.loss(sm.model(lo), hr))
+    sm.step()
+    with pytest.raises(ValueError, match="ema_decay"):
+        sm.eval_step(use_ema=True)(lo, hr)

@@ -71,3 +71,32 @@ def test_psnr_data_range():
         float(metrics.psnr(a, b, data_range=255.0)),
         10 * np.log10(255.0**2 / 51.0**2), rtol=1e-5,
     )
+
+
+def test_ssim_identity_and_bounds():
+    rng = np.random.default_rng(0)
+    a = rng.random((1, 16, 16, 3)).astype(np.float32)
+    assert float(metrics.ssim(a, a)) == pytest.approx(1.0, abs=1e-5)
+    noisy = np.clip(a + rng.normal(0, 0.2, a.shape).astype(np.float32), 0, 1)
+    s = float(metrics.ssim(a, noisy))
+    assert 0.0 < s < 1.0
+    # more noise -> lower ssim
+    noisier = np.clip(a + rng.normal(0, 0.5, a.shape).astype(np.float32), 0, 1)
+    assert float(metrics.ssim(a, noisier)) < s
+
+
+def test_ssim_constant_images_analytic():
+    """For constant images x=c1, y=c2 variances vanish: SSIM reduces to
+    the luminance term (2*c1*c2 + C1) / (c1^2 + c2^2 + C1)."""
+    c1v, c2v = 0.3, 0.7
+    a = np.full((16, 16, 1), c1v, np.float32)
+    b = np.full((16, 16, 1), c2v, np.float32)
+    C1 = 0.01**2
+    expect = (2 * c1v * c2v + C1) / (c1v**2 + c2v**2 + C1)
+    np.testing.assert_allclose(float(metrics.ssim(a, b)), expect, rtol=1e-4)
+
+
+def test_ssim_small_image_rejected():
+    a = np.zeros((8, 8, 3), np.float32)
+    with pytest.raises(ValueError, match="11x11"):
+        metrics.ssim(a, a)
