@@ -1,0 +1,129 @@
+"""A modern SR training recipe: everything beyond reference parity at once.
+
+The reference trains SwinIR with fixed patches, no augmentation, no EMA,
+no resumable checkpoints (`Stoke-DDP.py`). This recipe is what the same
+training looks like with the framework's extensions:
+
+- paired random augmentation (`PairedRandomAug`, epoch-driven by the loader)
+- flat fused AdamW with a parameter EMA maintained inside the compiled step
+- K steps per dispatch (`MultiStep` + `stack_windows`) for host-bound loops
+- async sharded checkpoints that overlap disk writes with training
+- validation on the EMA weights with PSNR + SSIM
+
+Fakes 8 devices on the host CPU; ``EXAMPLE_PLATFORM=tpu`` uses the real
+mesh instead.
+"""
+
+import shutil
+import tempfile
+
+import _bootstrap
+
+_bootstrap.setup(n_devices=8)
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributedtraining_tpu import metrics, optim
+from pytorch_distributedtraining_tpu.checkpoint_sharded import CheckpointManager
+from pytorch_distributedtraining_tpu.data import (
+    DataLoader,
+    PairedRandomAug,
+    SyntheticSRDataset,
+    stack_windows,
+)
+from pytorch_distributedtraining_tpu.losses import mse_loss
+from pytorch_distributedtraining_tpu.models import Net
+from pytorch_distributedtraining_tpu.parallel import (
+    DDP,
+    EvalStep,
+    MultiStep,
+    TrainStep,
+    create_train_state,
+)
+from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
+
+K, BATCH, EPOCHS = 2, 16, 2
+
+
+class _AugDataset(SyntheticSRDataset):
+    """Synthetic pairs + paired augmentation (stands in for
+    CustomDataset(..., transform=...) on a real patch folder)."""
+
+    def __init__(self, transform, **kw):
+        super().__init__(**kw)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        lr, hr = super().__getitem__(idx)
+        return self.transform(lr, hr, idx)
+
+
+def main():
+    mesh = make_mesh(MeshSpec.ddp(8))
+    aug = PairedRandomAug(scale=2, crop_lr=12, seed=0)
+    ds = _AugDataset(aug, n=64, lr_size=16, scale=2)
+    loader = DataLoader(ds, batch_size=BATCH, shuffle=True, drop_last=True)
+
+    model = Net(upscale_factor=2)
+    tx = optim.FusedAdamW(lr=2e-3, clip_grad_norm=1.0, ema_decay=0.95)
+
+    def loss_fn(params, batch, rng, ms):
+        lo, hr = batch
+        return mse_loss(model.apply({"params": params}, lo), hr), {}
+
+    state, sh = create_train_state(
+        init_fn=lambda r: (
+            model.init(r, jnp.zeros((1, 12, 12, 3)))["params"], {},
+        ),
+        tx=tx, mesh=mesh, policy=DDP(),
+    )
+    step = TrainStep(
+        loss_fn, tx, mesh, DDP(), state_shardings=sh, donate=False
+    )
+    multi = MultiStep(step, k=K)
+
+    root = tempfile.mkdtemp(prefix="sr_recipe_")
+    mgr = CheckpointManager(root, save_every=4, keep=2, async_save=True)
+    try:
+        with mesh:
+            for epoch in range(EPOCHS):
+                loader.set_epoch(epoch)  # shuffle AND augmentation epoch
+                for stacked in stack_windows(loader, K):
+                    state, m = multi(state, stacked)
+                    mgr.maybe_save(int(state.step), state)
+                print(f"epoch {epoch}: loss {float(m['loss'][-1]):.5f}")
+        mgr.wait()
+        print(f"checkpoints on disk: {mgr.all_steps()}")
+
+        # ---- validate the EMA weights with PSNR + SSIM -------------------
+        ema = tx.ema_params(state.opt_state, state.params)
+        rng = np.random.default_rng(99)
+        hr = rng.random((BATCH, 24, 24, 3)).astype(np.float32)
+        lo = hr.reshape(BATCH, 12, 2, 12, 2, 3).mean(axis=(2, 4))
+
+        def eval_fn(params, batch, ms):
+            lo_b, hr_b = batch
+            out = model.apply({"params": params}, lo_b)
+            return {
+                "psnr": metrics.psnr(out, hr_b),
+                "ssim": metrics.ssim(out, hr_b),
+            }
+
+        ev = EvalStep(eval_fn, mesh, state_shardings=sh)
+        raw = ev(state, (lo, hr))
+        ema_m = ev(state.replace(params=ema), (lo, hr))
+        print(f"raw  weights: psnr {float(raw['psnr']):.2f} dB, "
+              f"ssim {float(raw['ssim']):.4f}")
+        print(f"EMA  weights: psnr {float(ema_m['psnr']):.2f} dB, "
+              f"ssim {float(ema_m['ssim']):.4f}")
+        print("recipe complete")
+    finally:
+        mgr.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

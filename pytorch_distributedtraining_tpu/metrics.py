@@ -48,6 +48,8 @@ def ssim(outputs, targets, data_range: float = 1.0):
         # a silent broadcast here would die later inside the conv with an
         # opaque dimension_numbers error
         raise ValueError(f"shape mismatch: {x.shape} vs {y.shape}")
+    if x.ndim not in (3, 4):
+        raise ValueError(f"ssim expects HWC or NHWC, got shape {x.shape}")
     if x.ndim == 3:
         x, y = x[None], y[None]
     if x.shape[1] < 11 or x.shape[2] < 11:

@@ -115,6 +115,20 @@ def refresh_params_ema(prev_opt_state, new_opt_state, new_params):
     )
 
 
+def has_ema(opt_state) -> bool:
+    """Cheap presence probe: is an EMA being tracked in this state?
+    (No extraction — :func:`ema_params` materializes the tree.)"""
+    is_state = lambda x: isinstance(  # noqa: E731
+        x, (ParamsEMAState, FusedAdamWState)
+    )
+    return any(
+        isinstance(s, ParamsEMAState)
+        or (isinstance(s, FusedAdamWState) and s.ema is not None)
+        for s in jax.tree.leaves(opt_state, is_leaf=is_state)
+        if is_state(s)
+    )
+
+
 def ema_params(opt_state, params=None):
     """Dig the EMA tree out of an optimizer state (tree OR fused path).
 

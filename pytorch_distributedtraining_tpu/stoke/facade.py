@@ -1403,9 +1403,10 @@ class Stoke:
         """
         self._require_state()
         metric_fns = dict(metric_fns or {})
-        if use_ema and self.ema_params is None:
+        if use_ema and not optim_mod.has_ema(self._state.opt_state):
             # whether an EMA is tracked is fixed at optimizer
             # construction — fail at build, not on the first batch
+            # (presence probe only: extraction is paid per epoch below)
             raise ValueError(
                 "use_ema=True but no EMA is tracked — pass "
                 "optimizer_kwargs={'ema_decay': ...}"
@@ -1460,22 +1461,22 @@ class Stoke:
             inners[ikey] = inner
 
         # EMA extraction is opt_state-fixed for a whole validation epoch:
-        # memoize per state identity, and place the tree on the DECLARED
-        # param shardings so the jitted step never reshards per batch
-        # (and host-offloaded layouts keep their memory kind)
-        ema_cache: dict = {"key": None, "tree": None}
+        # memoize per state object (held by reference — an `id` key could
+        # be recycled after GC and silently serve a stale tree), and place
+        # the tree on the DECLARED param shardings so the jitted step never
+        # reshards per batch (host-offloaded layouts keep their memory kind)
+        ema_cache: dict = {"state": None, "tree": None}
 
         def step(inputs, targets):
             st = self._state
             if use_ema:
-                k = id(st.opt_state)
-                if ema_cache["key"] != k:
+                if ema_cache["state"] is not st.opt_state:
                     ep = self.ema_params
                     ep = jax.tree.map(
                         lambda e, s: jax.device_put(e, s),
                         ep, self._shardings.params,
                     )
-                    ema_cache["key"], ema_cache["tree"] = k, ep
+                    ema_cache["state"], ema_cache["tree"] = st.opt_state, ep
                 st = st.replace(params=ema_cache["tree"])
             batch = (self._shard_batch(inputs), self._shard_batch(targets))
             return inner(st, batch)
