@@ -118,3 +118,30 @@ def test_stack_windows_feeds_multi(devices8):
         state, m = multi(state, stacked)
         n += 1
     assert n == 2 and int(state.step) == 2 * K
+
+
+def test_stack_windows_device_batches(devices8):
+    """Mesh-equipped loader batches are jax Arrays: stacking must stay an
+    XLA op (no host round-trip / non-addressable crash), and the stacks
+    must feed MultiStep."""
+    from jax.sharding import PartitionSpec as P
+
+    from pytorch_distributedtraining_tpu.data import (
+        DataLoader,
+        SyntheticSRDataset,
+        stack_windows,
+    )
+
+    mesh = make_mesh(MeshSpec.ddp(8), devices=devices8)
+    ds = SyntheticSRDataset(n=32, lr_size=8, scale=2)
+    loader = DataLoader(
+        ds, batch_size=16, mesh=mesh, spec=P("dp"), drop_last=True
+    )
+    mesh2, state, step = _build(devices8, DDP())
+    multi = MultiStep(step, k=2)
+    n = 0
+    for stacked in stack_windows(loader, 2):
+        assert hasattr(stacked[0], "sharding"), "left device unexpectedly"
+        state, m = multi(state, stacked)
+        n += 1
+    assert n == 1 and int(state.step) == 2
