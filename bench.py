@@ -481,13 +481,13 @@ def _bench() -> None:
             # every retry attempt on the same unreadable file
             raise SystemExit(f"bench_knobs.json unreadable: {e}")
         unknown = set(knobs) - {
-            "attn", "attn_pack", "norm", "softmax", "opt", "loop",
+            "attn", "attn_pack", "norm", "softmax", "opt", "loop", "scan_k",
         }
         if unknown:
             # a typoed key would otherwise silently no-op the default flip
             raise SystemExit(
                 f"bench_knobs.json unknown keys {sorted(unknown)}; valid: "
-                "attn, attn_pack, norm, softmax, opt, loop"
+                "attn, attn_pack, norm, softmax, opt, loop, scan_k"
             )
 
     resolved = {}  # effective value + where it came from, for the log line
@@ -552,7 +552,16 @@ def _bench() -> None:
             raise SystemExit(f"{name} must be an int, got {raw!r}")
 
     windows = max(1, int_env("GRAFT_BENCH_WINDOWS", "3"))
-    scan_k_raw = int_env("GRAFT_BENCH_SCAN_K", "0")
+    # knob-resolved (env > json > default) so a measured winning k can be
+    # committed as data, like the opt/loop winners
+    scan_k_str = knob("GRAFT_BENCH_SCAN_K", "scan_k", "0")
+    try:
+        scan_k_raw = int(scan_k_str)
+    except ValueError:
+        raise SystemExit(
+            f"scan_k must be an int, got {scan_k_str!r} "
+            f"(from {resolved['scan_k'][1]})"
+        )
     if any(src != "default" for _, src in resolved.values()):
         # the EFFECTIVE config (env > json > default), not the raw file —
         # result logs must attribute numbers to what actually ran
