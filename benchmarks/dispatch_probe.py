@@ -37,6 +37,7 @@ def main() -> None:
     )
 
     force_platform_from_env("GRAFT_BENCH_PLATFORM")
+    import numpy as np
     import jax
     import jax.numpy as jnp
 
@@ -111,6 +112,18 @@ def main() -> None:
         return time.perf_counter() - t0
 
     emit("donate_12mb_dispatch", run_big(), run_big(), "us/dispatch")
+
+    # -- 3b: N host->device transfers of a batch-sized buffer --------------
+    # (the flagship batch is ~4.4 MB; MultiStep's k-stacks are k of these)
+    host_buf = np.ones((1_100_000,), np.float32)  # ~4.4 MB
+
+    def run_h2d():
+        t0 = time.perf_counter()
+        outs = [jax.device_put(host_buf, dev) for _ in range(N)]
+        jax.block_until_ready(outs)
+        return time.perf_counter() - t0
+
+    emit("h2d_4mb", run_h2d(), run_h2d(), "us/transfer")
 
     # -- 4: one dispatch of an N-length scan carrying the 12 MB buffer -----
     def scan_big(b):
