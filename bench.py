@@ -10,18 +10,23 @@ trained; an A100 at ~50% bf16 utilization (~150 TFLOP/s) gives ~7000
 img/s, derated to 6000 for data/optimizer overhead. The ratio is the
 trackable cross-round number; BASELINE.json's north star asks for >=0.70.
 
-Prints ONE JSON result line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON result line: {"metric", "value", "unit", "vs_baseline"},
+plus audit fields {"windows", "window_rates", "steps_per_window", "batch"}
+so best-of-N records are distinguishable from single-window ones.
 Progress lines prefixed with ``# `` are streamed (unbuffered) as the run
 proceeds so a driver-side kill can never observe an empty output tail.
 
 Failure envelope (the round-2 artifact was rc=124 with an *empty* tail
-because the old parent buffered everything and its worst-case budget was
-~46 min): the parent now enforces a hard self-deadline (default 330 s,
-well under any plausible driver timeout), probes TPU backend init with a
-short bound before spending real time, streams every child line the moment
-it appears, and converts SIGTERM/SIGALRM/budget-expiry into the structured
-error record. The only terminal states are rc=0 with a value>0 record or
-rc=1 with an error record — never silence.
+because the old parent buffered everything): the parent enforces a hard
+self-deadline (default 50 min — the shared pool's outage windows are the
+dominant failure mode, so a down pool is now wait-then-retry: probe every
+~2 min until either the pool answers or only the measurement reserve
+remains on the clock), streams every child line the moment it appears,
+and converts SIGTERM/SIGALRM/budget-expiry into the structured error
+record. A driver-side `timeout` shorter than the budget lands on the
+SIGTERM path, which still prints the record before exit. The only
+terminal states are rc=0 with a value>0 record or rc=1 with an error
+record — never silence.
 """
 
 from __future__ import annotations
@@ -45,10 +50,17 @@ WARMUP = max(1, int(os.environ.get("GRAFT_BENCH_WARMUP", "3")))
 METRIC = "swinir_s_x2_train_images_per_sec_per_chip"
 UNIT = "images/sec/chip"
 
-# Budget envelope. Total self-deadline stays far under any driver timeout;
-# within it: one short backend probe, then up to ATTEMPTS bench children.
-TOTAL_BUDGET_S = int(os.environ.get("GRAFT_BENCH_TOTAL", "330"))
+# Budget envelope. Four rounds of official captures died to pool outages
+# (BENCH_r01 rc=1, r02 rc=124, r03/r04 value 0.0 — VERDICT r4 missing #1),
+# so the default budget is now generous: a down pool is probed every
+# PROBE_INTERVAL_S until it answers or until only MEASURE_RESERVE_S (the
+# time a probe + compile + timed windows need) remains on the clock. The
+# watcher's A/B stages pin GRAFT_BENCH_TOTAL low explicitly, so they keep
+# the old fail-fast behavior.
+TOTAL_BUDGET_S = int(os.environ.get("GRAFT_BENCH_TOTAL", "3000"))
 PROBE_TIMEOUT_S = int(os.environ.get("GRAFT_BENCH_PROBE", "70"))
+PROBE_INTERVAL_S = int(os.environ.get("GRAFT_BENCH_PROBE_INTERVAL", "120"))
+MEASURE_RESERVE_S = int(os.environ.get("GRAFT_BENCH_RESERVE", "300"))
 ATTEMPTS = int(os.environ.get("GRAFT_BENCH_ATTEMPTS", "2"))
 # 0 = no per-attempt cap (each attempt may use the whole remaining clock)
 ATTEMPT_TIMEOUT_S = int(os.environ.get("GRAFT_BENCH_TIMEOUT", "0"))
@@ -325,23 +337,58 @@ def main() -> None:
     except OSError:
         pass
 
-    # Phase 1: bounded backend-init probe. A hung TPU claim loop dies here
-    # in ~PROBE_TIMEOUT_S instead of eating the whole budget.
-    t0 = time.monotonic()
-    rc, out, diag = _run_child(
-        {"_GRAFT_BENCH_PROBE": "1"}, min(PROBE_TIMEOUT_S, _remaining() - 10)
-    )
-    probe_dt = time.monotonic() - t0
-    tail = _informative_tail(diag)[:300]
-    if rc is None:
-        _emit_error(
-            f"TPU backend init probe hung >{PROBE_TIMEOUT_S:.0f}s "
-            f"(pool unavailable); last: {tail}"
+    # Phase 1: bounded backend-init probes in a wait-then-retry loop. The
+    # shared pool's outage windows (17 min - day+, BASELINE.md) are the
+    # dominant capture failure, so a failed probe sleeps PROBE_INTERVAL_S
+    # and retries for as long as the clock still fits a sleep + probe +
+    # MEASURE_RESERVE_S of actual measurement. Each individual probe stays
+    # bounded at PROBE_TIMEOUT_S so a hung claim loop can't eat the clock.
+    wait_t0 = time.monotonic()
+    probe_n = 0
+    fast_fails = 0
+    while True:
+        probe_n += 1
+        t0 = time.monotonic()
+        rc, out, diag = _run_child(
+            {"_GRAFT_BENCH_PROBE": "1"},
+            min(PROBE_TIMEOUT_S, _remaining() - 10),
         )
-    if rc != 0:
-        _emit_error(f"TPU backend init probe failed rc={rc}: {tail}")
+        probe_dt = time.monotonic() - t0
+        tail = _informative_tail(diag)[:300]
+        if rc == 0:
+            break
+        waited = time.monotonic() - wait_t0
+        cause = (
+            f"hung >{PROBE_TIMEOUT_S:.0f}s" if rc is None else f"rc={rc}"
+        )
+        # Outage-class failures ride the wait loop: a hung probe, the
+        # pool's raised "UNAVAILABLE: TPU backend ..." (rc=1 with the
+        # sentinel in the tail, BASELINE.md outage signatures), or the
+        # CPU-fallback refusal (probe rc=3) — all of these resolve when
+        # the window opens. Anything else (ImportError, a typoed
+        # platform) is deterministic: a couple of retries for
+        # flap-transients, then fail fast with its own cause instead of
+        # burning the whole budget relabeling it "pool unavailable".
+        outage_class = rc is None or rc == 3 or "UNAVAILABLE" in tail
+        fast_fails = 0 if outage_class else fast_fails + 1
+        if fast_fails >= 3:
+            _emit_error(
+                f"TPU backend probe failed deterministically "
+                f"({fast_fails}x {cause}, not a pool outage): {tail}"
+            )
+        sleep_s = max(0.0, PROBE_INTERVAL_S - probe_dt)
+        if _remaining() < sleep_s + PROBE_TIMEOUT_S + MEASURE_RESERVE_S:
+            _emit_error(
+                f"TPU pool unavailable for {waited:.0f}s across {probe_n} "
+                f"probes (last: {cause}); last output: {tail}"
+            )
+        _status(
+            f"probe {probe_n} {cause}; pool down {waited:.0f}s, "
+            f"retrying in {sleep_s:.0f}s ({_remaining():.0f}s on clock)"
+        )
+        time.sleep(sleep_s)
     plat = next((l for l in out if l.startswith("platform=")), tail)
-    _status(f"probe ok in {probe_dt:.1f}s: {plat}")
+    _status(f"probe ok in {probe_dt:.1f}s (probe {probe_n}): {plat}")
 
     # Phase 2: the bench itself. Retries exist for fast flaky-init crashes;
     # a *timed-out* attempt consumed the budget (e.g. cold-cache compile),
@@ -629,6 +676,7 @@ def _bench() -> None:
         # reports the chip's capability rather than the instantaneous
         # tunnel weather, and every window is logged for transparency.
         rates: list[float] = []
+        actual_steps = STEPS  # scan mode may round up to k*ceil(STEPS/k)
         if loop_impl == "scan":
             # k steps per dispatch (default: the whole window in one call).
             # Small k amortizes the tunnel's per-dispatch cost by k while
@@ -638,6 +686,7 @@ def _bench() -> None:
             # K value still measures (at least) the committed sustained
             # methodology; the rate math below uses the true k*n_calls
             n_calls = -(-STEPS // k)
+            actual_steps = k * n_calls
             if k * n_calls != STEPS:
                 print(
                     f"# child: scan k={k} does not divide STEPS={STEPS}; "
@@ -721,6 +770,27 @@ def _bench() -> None:
                 )
 
     img_per_sec = max(rates)
+    # Roofline guard (VERDICT r4 #5): SwinIR-S x2 at 64x64 trains at ~21
+    # GFLOPs/image (fwd+bwd, BASELINE.md derivation); no v5e-class chip
+    # exceeds ~1 PFLOP/s effective bf16 (best sustained measurement here:
+    # 649 TFLOP/s). A rate above peak/model-FLOPs is an instrument failure
+    # (e.g. async dispatch not actually synced), never a measurement —
+    # refuse to publish it.
+    roofline_img_s = 1000e12 / 21e9
+    if img_per_sec > roofline_img_s:
+        # no "# " prefix: _informative_tail must pick THIS line (not
+        # stderr chatter) as the cause in the parent's error record
+        print(
+            f"ROOFLINE VIOLATION: {img_per_sec:.0f} img/s exceeds the "
+            f"{roofline_img_s:.0f} img/s compute bound "
+            f"(1 PFLOP/s / 21 GFLOP per image) — timing loop is broken, "
+            f"refusing to publish",
+            flush=True,
+        )
+        sys.exit(5)
+    # windows/window_rates make the methodology auditable from the record
+    # itself (ADVICE r4 #1): best-of-N is distinguishable from a
+    # single-window number, and the spread is the variance envelope.
     print(
         json.dumps(
             {
@@ -728,6 +798,10 @@ def _bench() -> None:
                 "value": round(img_per_sec, 2),
                 "unit": UNIT,
                 "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+                "windows": len(rates),
+                "window_rates": [round(r, 1) for r in rates],
+                "steps_per_window": actual_steps,
+                "batch": BATCH,
             }
         )
     )

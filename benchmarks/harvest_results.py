@@ -6,7 +6,7 @@ This script turns that directory into a ready-to-append markdown section
 so the measured numbers reach BASELINE.md even when the pool window
 opens with nobody at the wheel:
 
-    python benchmarks/harvest_results.py /tmp/tpu_results >> BASELINE.md
+    python benchmarks/harvest_results.py benchmarks/results_r5/w1 >> BASELINE.md
 
 Only JSON lines are consumed; stages that are missing, empty, or
 error-only are listed as such rather than silently dropped.
@@ -23,6 +23,13 @@ import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 STAGES = [
     ("bench", "headline SwinIR-S x2 train step (bench.py, committed knobs)"),
+    # round-5 chain stage names (benchmarks/tpu_chain.sh r5)
+    ("dispatch_probe", "tunnel dispatch-cost decomposition (dispatch_probe.py)"),
+    ("bench_scan_k10", "bench.py, fused + lax.scan k=10 per dispatch"),
+    ("bench_scan_k25", "bench.py, fused + lax.scan k=25 per dispatch"),
+    ("bench_scan_full", "bench.py, fused + lax.scan whole window per dispatch"),
+    ("ladder_all", "five-config ladder, 200-step best-of-3 (ladder.py --all)"),
+    ("attn8k", "flash attention at T=8k/16k crossover hunt (attn_bench.py)"),
     ("bench_s200", "bench.py, committed knobs, STEPS=200 sustained"),
     ("bench_chain", "bench.py, per-leaf optax chain, STEPS=200"),
     ("bench_fused_bf16ln", "bench.py, fused opt + bf16 LayerNorms, STEPS=200"),
@@ -82,11 +89,12 @@ def _json_lines(path: str):
     return rows
 
 
-def render(results_dir: str) -> str:
+def render(results_dir: str, window: str | None = None) -> str:
+    wtag = f", pool window {window}" if window else ""
     out = [
         "",
         "### Harvested on-chip results "
-        f"({time.strftime('%Y-%m-%d %H:%M', time.gmtime())} UTC, "
+        f"({time.strftime('%Y-%m-%d %H:%M', time.gmtime())} UTC{wtag}, "
         "auto-collected by the outage watcher)",
         "",
     ]
@@ -94,7 +102,11 @@ def render(results_dir: str) -> str:
     for stage, desc in STAGES:
         rows = _json_lines(os.path.join(results_dir, f"{stage}.txt"))
         if rows is None:
-            out.append(f"- **{stage}** ({desc}): not run")
+            # STAGES is the union of every round's chain arms; a missing
+            # file means this chain never staged it — listing those as
+            # "not run" would read as failures and bury the real rows.
+            # A stage that RAN but emitted nothing still shows up below
+            # as "no JSON output".
             continue
         if not rows:
             out.append(f"- **{stage}** ({desc}): no JSON output")
@@ -137,9 +149,13 @@ def render(results_dir: str) -> str:
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("results_dir")
+    ap.add_argument(
+        "--window", default=None,
+        help="pool-window label for the section header (variance envelope)",
+    )
     opt = ap.parse_args(argv)
     try:
-        print(render(opt.results_dir))
+        print(render(opt.results_dir, opt.window))
     except BrokenPipeError:  # e.g. piped into head
         pass
 
