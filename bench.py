@@ -769,6 +769,18 @@ def _bench() -> None:
                     flush=True,
                 )
 
+    # untimed verification fetch: the loss chains through every timed
+    # step, so a real finite host value proves the windows executed —
+    # block_until_ready through the experimental tunnel under-blocked in
+    # the r4 decode artifact. Untimed because one ~100 ms RTT would
+    # distort a ~0.3 s window; the roofline guard bounds a residual lie.
+    final_loss = float(
+        jnp.ravel(losses)[-1] if loop_impl == "scan" else metrics["loss"]
+    )
+    if not np.isfinite(final_loss):
+        print(f"non-finite loss after timing: {final_loss}", flush=True)
+        sys.exit(6)
+
     img_per_sec = max(rates)
     # Roofline guard (VERDICT r4 #5): SwinIR-S x2 at 64x64 trains at ~21
     # GFLOPs/image (fwd+bwd, BASELINE.md derivation); no v5e-class chip
@@ -802,6 +814,7 @@ def _bench() -> None:
                 "window_rates": [round(r, 1) for r in rates],
                 "steps_per_window": actual_steps,
                 "batch": BATCH,
+                "final_loss": round(final_loss, 6),
             }
         )
     )

@@ -1,0 +1,45 @@
+"""Shared roofline guard for the benchmark suite (VERDICT r4 next #5).
+
+Every bench computes a deliberately generous physical upper bound for its
+own metric (1 PFLOP/s chip compute, 2 TB/s HBM — both above any v5e-class
+part; best sustained measurement here is 649 TFLOP/s, BASELINE.md r4) and
+refuses to publish a value above it: such a value is always an instrument
+failure (e.g. async dispatch that never really synced — the r4 decode
+artifact at ~100x the weight-read bound), never a measurement.
+
+Two failure styles:
+  - guard(..., soft=False): print the violation line and SystemExit(5) —
+    for benches where one broken number poisons the whole run.
+  - guard(..., soft=True): raise RuntimeError instead, for callers with
+    per-arm isolation (ladder.py) where the other arms' numbers must
+    survive the violating one.
+
+The violation line carries no "# " prefix and is also recognized by
+harvest_results.py, so the cause reaches BASELINE.md, not just stderr.
+"""
+
+from __future__ import annotations
+
+VIOLATION_PREFIX = "ROOFLINE VIOLATION"
+
+
+def guard(
+    label: str,
+    value: float,
+    unit: str,
+    bound: float,
+    detail: str,
+    soft: bool = False,
+) -> None:
+    """No-op when value <= bound; otherwise publish the cause and fail."""
+    if value <= bound:
+        return
+    msg = (
+        f"{VIOLATION_PREFIX}: {label} {value:.0f} {unit} exceeds the "
+        f"{bound:.0f} {unit} bound ({detail}) — timing loop is broken, "
+        f"refusing to publish"
+    )
+    print(msg, flush=True)
+    if soft:
+        raise RuntimeError(msg)
+    raise SystemExit(5)

@@ -21,6 +21,7 @@ import os
 import time
 
 import _bootstrap  # noqa: F401  (repo root on sys.path)
+from _roofline import guard
 
 CPU_SELF_TEST = os.environ.get("GRAFT_BENCH_PLATFORM") == "cpu"
 BATCH = max(1, int(os.environ.get("GRAFT_DECODE_BATCH", "2" if CPU_SELF_TEST else "8")))
@@ -70,6 +71,16 @@ def main() -> None:
         jax.random.PRNGKey(0), jnp.zeros((1, PROMPT), jnp.int32)
     )["params"]
 
+    # Roofline (VERDICT r4 weak #2 / next #5): each decode step re-reads
+    # every weight once, so tokens/sec <= BATCH * HBM_BW / weight_bytes.
+    # 2 TB/s is a deliberately generous ceiling (v5e-class HBM is ~819
+    # GB/s); a number above even THIS bound is an instrument failure
+    # (async dispatch not actually synced), never a measurement. The r4
+    # artifact (2.55M tok/s greedy at batch 8) violated it ~100x.
+    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+    weight_bytes = 2.0 * n_params  # bf16 compute path
+    roofline_tok_s = BATCH * 2e12 / weight_bytes
+
     for metric, kwargs in (
         ("gpt2_decode_tokens_per_sec", dict(temperature=0.0)),
         ("gpt2_decode_topp_tokens_per_sec", dict(top_p=0.9)),
@@ -81,17 +92,38 @@ def main() -> None:
         )
         out = run(params, prompt)  # compile + warm
         jax.block_until_ready(out)
+        # pre-warm the tiny chaining ops too (they jit-compile on first
+        # use; on CPU self-test their compile dwarfed a whole greedy rep)
+        warm_carry = out[:, -1].max().astype(jnp.int32)
+        jax.block_until_ready((prompt + warm_carry) % cfg.vocab_size)
+        # Chain the reps device-side: rep i's prompt depends on rep i-1's
+        # output, so neither the tunnel's (program, args) memoization nor
+        # queue-level overlap can collapse the sequence; the final int()
+        # is a host fetch that transitively waits on EVERY rep (the r4
+        # loop trusted block_until_ready through the experimental axon
+        # platform and measured dispatch, not decode).
+        carry = jnp.int32(0)
         t0 = time.perf_counter()
         for i in range(REPS):
-            out = run(params, prompts[i])
-        jax.block_until_ready(out)
+            pr = (prompts[i] + carry) % cfg.vocab_size
+            out = run(params, pr)
+            carry = out[:, -1].max().astype(jnp.int32)
+        fetched = int(carry)  # host round-trip ends the timed region
         dt = (time.perf_counter() - t0) / REPS
         assert out.shape == (BATCH, PROMPT + NEW), out.shape
+        assert 0 <= fetched < cfg.vocab_size, fetched
+        tok_s = BATCH * NEW / dt
+        guard(
+            metric, tok_s, "tokens/sec", roofline_tok_s,
+            f"batch {BATCH} x 2 TB/s HBM / {weight_bytes / 1e6:.0f} MB "
+            f"weights read per step",
+        )
         print(json.dumps({
             "metric": metric,
-            "value": round(BATCH * NEW / dt, 1),
+            "value": round(tok_s, 1),
             "unit": "tokens/sec",
             "ms_per_token": round(dt / NEW * 1e3, 3),
+            "roofline_tok_s": round(roofline_tok_s, 1),
         }), flush=True)
 
 

@@ -80,6 +80,11 @@ def _json_lines(path: str):
     with open(path, encoding="utf-8", errors="replace") as fh:
         for line in fh:
             line = line.strip()
+            if line.startswith("ROOFLINE VIOLATION"):
+                # the guards' cause line (benchmarks/_roofline.py) must
+                # reach BASELINE.md, not just the stage's watch.log tail
+                rows.append({"error": line})
+                continue
             if not line.startswith("{"):
                 continue
             try:

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 import _bootstrap  # noqa: F401  (repo root on sys.path)
+from _roofline import guard
 
 from pytorch_distributedtraining_tpu import optim
 from pytorch_distributedtraining_tpu.losses import mse_loss
@@ -130,6 +131,64 @@ def time_fn(fn, params, batch):
     return (time.perf_counter() - t0) / STEPS
 
 
+def measure_peak():
+    """Empirical bf16 matmul peak — the MFU denominator (VERDICT r4 #7).
+
+    The labeled 197 TFLOP/s v5e peak does not describe this pool's chips:
+    round-4 sessions measured 649 TFLOP/s effective on batch-72 SwinIR and
+    ~790 TFLOP/s forward-only, so every "X% MFU" computed against 197 was
+    miscalibrated (some >100%). This stage times K chained square bf16
+    matmuls in ONE dispatch (sequential data dependency, so the tunnel can
+    neither overlap nor memoize them; one dispatch so the 1-core host's
+    ~1.5 ms/call cost stays amortized) and reports the best-of-3 rate as
+    the measured peak for this session.
+    """
+    n = 256 if TINY else 8192
+    k_chain = 2 if TINY else 16
+    rng = np.random.default_rng(0)
+    # evolving random data, variance-preserving mixer (var(x@b) ~ var(x)):
+    # ones @ const would make every chained value bit-identical, handing
+    # the tunnel's (program, args) memoization a way to skip reps 2-3
+    a = jnp.asarray(
+        rng.standard_normal((n, n)).astype(np.float32), jnp.bfloat16
+    )
+    b = jnp.asarray(
+        (rng.standard_normal((n, n)) / np.sqrt(n)).astype(np.float32),
+        jnp.bfloat16,
+    )
+
+    @jax.jit
+    def chained(x, b):
+        for _ in range(k_chain):
+            x = x @ b
+        return x
+
+    out = chained(a, b)  # compile + warm
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = chained(out, b)  # feed back: reps chain, args never repeat
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    probe = float(out[0, 0])  # untimed verification fetch
+    if not np.isfinite(probe):
+        raise SystemExit(f"peak probe produced non-finite output: {probe}")
+    tflops = 2 * n * n * n * k_chain / best / 1e12
+    # the denominator of every MFU line must itself be physical
+    guard(
+        "peak_probe", tflops, "TFLOP/s", 1500.0,
+        "no v5e-class chip exceeds ~1 PFLOP/s bf16; 1.5x margin",
+    )
+    print(json.dumps({
+        "stage": "peak_probe",
+        "measured_peak_tflops": round(tflops, 1),
+        "matmul_n": n,
+        "chain_len": k_chain,
+    }), flush=True)
+    return tflops * 1e12
+
+
 def report(variant, sec, batch=BATCH):
     print(json.dumps({
         "variant": variant,
@@ -186,7 +245,10 @@ def analytic_model():
         "analytic_fwd_gflops_per_img": round(fwd_flops / 1e9, 2),
         "analytic_train_gflops_per_img": round(train_flops / 1e9, 2),
         "analytic_train_mb_per_img": round(train_bytes / 1e6, 1),
-        "compute_bound_img_per_sec_at_peak": round(
+        # labeled-peak bound only — this pool's chips measure 3-4x above
+        # the 197 TFLOP/s label (BASELINE.md round-5 calibration note),
+        # so measured img/s can legitimately exceed this line
+        "compute_bound_img_per_sec_at_labeled_197": round(
             PEAK_TFLOPS * 1e12 / train_flops, 0
         ),
         "bandwidth_bound_img_per_sec_at_819GBs": round(
@@ -222,6 +284,8 @@ def main():
     mesh, state, step, loss_fn = build_step(model, batch)
     print(json.dumps({"stage": "built step"}), flush=True)
 
+    measured_peak = measure_peak()  # flops/s; the honest MFU denominator
+
     sec = time_step(mesh, state, step, batch)
     report("full", sec)
 
@@ -237,7 +301,13 @@ def main():
         print(json.dumps({
             "xla_flops_per_step": flops,
             "flops_per_img": flops / BATCH,
-            "mfu_full": round(flops / sec / (PEAK_TFLOPS * 1e12), 4),
+            # the honest MFU: denominator is this session's measured peak
+            # (VERDICT r4 #7 — the labeled-197 figure produced >100% MFU
+            # claims in r2-r4; those lines are annotated in BASELINE.md)
+            "mfu_vs_measured_peak": round(flops / sec / measured_peak, 4),
+            "mfu_vs_labeled_197": round(
+                flops / sec / (PEAK_TFLOPS * 1e12), 4
+            ),
         }), flush=True)
     except Exception as e:  # cost analysis is best-effort
         print(json.dumps({"cost_analysis_error": str(e)[:200]}), flush=True)

@@ -1,0 +1,77 @@
+"""Round-5 instrument hardening: roofline guards + harvest rendering.
+
+The benchmarks refuse to publish physically impossible numbers (VERDICT
+r4 #5) and the watcher's harvester must carry a violation's cause into
+BASELINE.md instead of dropping it as a non-JSON line.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCHMARKS = os.path.join(REPO, "benchmarks")
+
+# load by file path (not sys.path) so the benchmarks dir's module names
+# (_bootstrap, ladder, ...) can't shadow anything for later tests
+import importlib.util  # noqa: E402
+
+_spec = importlib.util.spec_from_file_location(
+    "_roofline", os.path.join(BENCHMARKS, "_roofline.py")
+)
+_roofline = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_roofline)
+VIOLATION_PREFIX, guard = _roofline.VIOLATION_PREFIX, _roofline.guard
+
+
+class TestGuard:
+    def test_under_bound_is_noop(self, capsys):
+        guard("x", 10.0, "img/s", 100.0, "detail")
+        assert capsys.readouterr().out == ""
+
+    def test_over_bound_exits_5(self, capsys):
+        with pytest.raises(SystemExit) as ei:
+            guard("decode", 2.5e6, "tok/s", 3.3e4, "weight-read bound")
+        assert ei.value.code == 5
+        out = capsys.readouterr().out
+        assert out.startswith(VIOLATION_PREFIX)
+        assert "decode" in out and "weight-read bound" in out
+
+    def test_soft_raises_runtime_error(self):
+        # ladder's per-config isolation catches Exception, not SystemExit
+        with pytest.raises(RuntimeError, match=VIOLATION_PREFIX):
+            guard("cfg4", 2.0, "tok/s", 1.0, "d", soft=True)
+
+
+class TestHarvestViolations:
+    def test_violation_line_becomes_error_row(self, tmp_path):
+        (tmp_path / "decode.txt").write_text(
+            "# progress line\n"
+            f"{VIOLATION_PREFIX}: decode 2550000 tok/s exceeds the 33000 "
+            "tok/s bound (weights) — refusing to publish\n"
+        )
+        out = subprocess.run(
+            [sys.executable, os.path.join(BENCHMARKS, "harvest_results.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, cwd=BENCHMARKS,
+        )
+        assert out.returncode == 0, out.stderr
+        assert VIOLATION_PREFIX in out.stdout
+        # rendered as a row under the decode stage, not dropped
+        assert "**decode**" in out.stdout
+
+    def test_never_staged_arms_are_skipped(self, tmp_path):
+        (tmp_path / "bench.txt").write_text(
+            json.dumps({"metric": "m", "value": 1.0, "unit": "u"}) + "\n"
+        )
+        out = subprocess.run(
+            [sys.executable, os.path.join(BENCHMARKS, "harvest_results.py"),
+             str(tmp_path), "--window", "2"],
+            capture_output=True, text=True, cwd=BENCHMARKS,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "not run" not in out.stdout
+        assert "pool window 2" in out.stdout
