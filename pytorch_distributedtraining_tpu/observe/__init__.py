@@ -7,6 +7,13 @@ per-step timing.
 """
 
 from . import wandb_compat as wandb
+from .hlo import (
+    CollectiveOp,
+    collective_inventory,
+    counts,
+    has_logical_reduce_scatter,
+    max_all_reduce_elems,
+)
 from .sink import JSONLSink, MetricsSink, NullSink, WandbSink, make_sink
 from .profiling import StepTimer, trace
 
@@ -19,4 +26,9 @@ __all__ = [
     "make_sink",
     "StepTimer",
     "trace",
+    "CollectiveOp",
+    "collective_inventory",
+    "counts",
+    "has_logical_reduce_scatter",
+    "max_all_reduce_elems",
 ]

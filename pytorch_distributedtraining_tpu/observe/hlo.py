@@ -1,0 +1,116 @@
+"""Compiled-HLO collective auditing: prove a sharded program's wire plan.
+
+The runtime tests prove sharded configs converge; this module proves the
+*compiler* emitted the communication pattern a policy promises — catching
+GSPMD silently replicating (a constraint backing off to a full-tensor
+all-reduce plus full-size update math), which a loss curve cannot see.
+The reference stack has no equivalent: torch DDP/fairscale hand-write
+their NCCL calls, so "which collectives run" is static; under XLA it is a
+compiler decision and deserves an assertion surface (SURVEY §5 aux
+tooling; VERDICT r4 next #10).
+
+Backend note: the XLA:CPU pass pipeline lacks the reduce-scatter-creator
+rewrite, so a ZeRO-2 grad constraint compiles there as its logical form —
+a (possibly tuple-combined) full all-reduce followed by ``dynamic-slice``
+to the shard — while XLA:TPU emits a literal ``reduce-scatter``. Audits
+that must hold on both backends should accept either form; see
+``has_logical_reduce_scatter``.
+
+Typical use::
+
+    hlo = step.compiled_text(state, batch)       # or any .compile().as_text()
+    inv = collective_inventory(hlo)
+    assert any(op.kind == "all-gather" for op in inv)
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+_OP_RE = re.compile(
+    r"\b(all-reduce|reduce-scatter|all-gather|collective-permute|"
+    r"all-to-all)(?:-start)?\("
+)
+_SHAPE_RE = re.compile(r"\[([0-9,]*)\]")
+
+
+def _elems(group: str) -> int:
+    n = 1
+    for d in group.split(","):
+        if d:
+            n *= int(d)
+    return n
+
+
+@dataclass(frozen=True)
+class CollectiveOp:
+    """One collective in a compiled HLO module."""
+
+    kind: str        # all-reduce | reduce-scatter | all-gather | ...
+    max_elems: int   # largest result-tensor element count (tuple-aware)
+    line: str        # the HLO line, for debugging failed assertions
+
+    def __repr__(self) -> str:  # keep pytest output readable
+        return f"CollectiveOp({self.kind}, {self.max_elems})"
+
+
+def collective_inventory(hlo_text: str) -> list[CollectiveOp]:
+    """Parse a compiled HLO module's collectives with result sizes.
+
+    Sizes come from the *result* type on the left of ``=`` (per-partition
+    shapes in an SPMD module); tuple-shaped combined collectives report
+    the largest member. Works on ``compiled.as_text()`` output.
+    """
+    out = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if m is None:
+            continue
+        lhs = line.split(m.group(0))[0]
+        sizes = [_elems(g) for g in _SHAPE_RE.findall(lhs)]
+        out.append(
+            CollectiveOp(m.group(1), max(sizes) if sizes else 1, line.strip())
+        )
+    return out
+
+
+def max_all_reduce_elems(hlo_text: str) -> int:
+    """Largest all-reduce result in the module (0 when none).
+
+    The headline audit number for ZeRO-2+: after the TPU reduce-scatter
+    rewrite, no *gradient-sized* all-reduce should remain — only scalar
+    loss/grad-norm reductions.
+    """
+    sizes = [
+        op.max_elems
+        for op in collective_inventory(hlo_text)
+        if op.kind == "all-reduce"
+    ]
+    return max(sizes, default=0)
+
+
+def has_logical_reduce_scatter(hlo_text: str, shard_elems: int) -> bool:
+    """True when the module reduce-scatters — literally, or in the CPU
+    pipeline's unfused form (an all-reduce whose consumers dynamic-slice
+    down to ``shard_elems``-sized shards)."""
+    inv = collective_inventory(hlo_text)
+    if any(op.kind == "reduce-scatter" for op in inv):
+        return True
+    if not any(op.kind == "all-reduce" for op in inv):
+        return False
+    for line in hlo_text.splitlines():
+        if "dynamic-slice(" not in line:
+            continue
+        lhs = line.split("dynamic-slice(")[0]
+        if any(_elems(g) == shard_elems for g in _SHAPE_RE.findall(lhs)):
+            return True
+    return False
+
+
+def counts(hlo_text: str) -> dict[str, int]:
+    """{kind: occurrences} — the one-line summary used by benchmarks."""
+    agg: dict[str, int] = {}
+    for op in collective_inventory(hlo_text):
+        agg[op.kind] = agg.get(op.kind, 0) + 1
+    return agg

@@ -384,6 +384,16 @@ class TrainStep:
         with self.mesh:
             self._jitted.lower(state, batch, jnp.float32(lr_factor)).compile()
 
+    def compiled_text(self, state: TrainState, batch, lr_factor: float = 1.0):
+        """Compiled HLO of this step, for `observe.hlo` collective audits
+        (prove the compiler emitted the policy's promised wire plan)."""
+        with self.mesh:
+            return (
+                self._jitted.lower(state, batch, jnp.float32(lr_factor))
+                .compile()
+                .as_text()
+            )
+
     def __call__(self, state: TrainState, batch, lr_factor: float = 1.0):
         return self._jitted(state, batch, jnp.float32(lr_factor))
 

@@ -1,0 +1,195 @@
+"""Compiled-HLO collective assertions per parallel policy (VERDICT r4 #10).
+
+The ZeRO/TP runtime tests prove convergence and shard layouts; these pin
+the *communication pattern* the compiler actually emitted — catching GSPMD
+silently replicating (a grad constraint backing off to full-tensor
+all-reduce plus full-size update math), which a loss curve cannot see.
+
+Reference framing: torch DDP's C++ Reducer and fairscale's ShardedDDP
+hand-place their NCCL all-reduce / reduce-scatter calls
+(`/root/reference/Fairscale-DDP.py:86-89` picks the wrapper; the wrapper
+picks the wire plan). Under XLA the wire plan is a compiler decision, so
+it gets an assertion surface instead.
+
+Backend note (see observe/hlo.py): XLA:CPU lacks the reduce-scatter
+rewrite, so ZeRO-2's grad constraint legitimately compiles here as the
+logical form — one (tuple-combined) all-reduce whose consumers
+dynamic-slice down to the shard before any optimizer math. The
+assertions accept literal reduce-scatter OR the logical form, and pin
+the structural facts that must hold on every backend: the constraint is
+in the lowered module, the update math runs at shard size, and updated
+params come back via all-gather. (A literal on-TPU inventory would need
+a multi-chip pool; the single tunnel chip compiles no collectives.)
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributedtraining_tpu import optim
+from pytorch_distributedtraining_tpu.losses import mse_loss
+from pytorch_distributedtraining_tpu.models import (
+    GPT2,
+    GPT2Config,
+    Net,
+    cross_entropy_loss,
+)
+from pytorch_distributedtraining_tpu.observe.hlo import (
+    collective_inventory,
+    counts,
+    has_logical_reduce_scatter,
+    max_all_reduce_elems,
+)
+from pytorch_distributedtraining_tpu.parallel import (
+    DDP,
+    TensorParallel,
+    TrainStep,
+    ZeRO1,
+    ZeRO2,
+    ZeRO3,
+    create_train_state,
+    tp_zero3,
+)
+from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
+
+
+def _build_net(mesh, policy):
+    model = Net(upscale_factor=2)
+    tx = optim.adamw(lr=1e-3)
+
+    def loss_fn(params, batch, rng, ms):
+        lr_img, hr_img = batch
+        return mse_loss(model.apply({"params": params}, lr_img), hr_img), {}
+
+    state, sh = create_train_state(
+        init_fn=lambda r: (
+            model.init(r, jnp.zeros((1, 8, 8, 3)))["params"], {},
+        ),
+        tx=tx, mesh=mesh, policy=policy,
+    )
+    step = TrainStep(
+        loss_fn, tx, mesh, policy, state_shardings=sh, donate=False
+    )
+    rng = np.random.default_rng(0)
+    hr = rng.random((16, 16, 16, 3)).astype(np.float32)
+    lr = hr.reshape(16, 8, 2, 8, 2, 3).mean(axis=(2, 4))
+    return state, step, (lr, hr)
+
+
+def _build_gpt(mesh, policy):
+    cfg = GPT2Config.tiny(n_embd=32, n_head=4)
+    model = GPT2(cfg)
+    tx = optim.adamw(lr=1e-3)
+
+    def loss_fn(params, batch, rng, ms):
+        logits = model.apply({"params": params}, batch)
+        return cross_entropy_loss(logits[:, :-1], batch[:, 1:]), {}
+
+    state, sh = create_train_state(
+        init_fn=lambda r: (
+            model.init(r, jnp.zeros((1, 8), jnp.int32))["params"], {},
+        ),
+        tx=tx, mesh=mesh, policy=policy,
+    )
+    step = TrainStep(
+        loss_fn, tx, mesh, policy, state_shardings=sh, donate=False
+    )
+    tok = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (8, 16)
+    ).astype(np.int32)
+    return state, step, tok
+
+
+def _hlo(mesh, policy, build=_build_net):
+    state, step, batch = build(mesh, policy)
+    return step.compiled_text(state, batch)
+
+
+# Net's three shardable kernels on an 8-way ZeRO axis: shard sizes the
+# update math must run at (full: 4800 / 18432 / 3456 elems, /8 each)
+NET_LARGEST_GRAD = 18432          # conv (3,3,64,32) — largest leaf
+NET_SHARD_ELEMS = 18432 // 8      # its 8-way shard
+
+
+@pytest.fixture()
+def zmesh(devices8):
+    return make_mesh(MeshSpec(fsdp=8), devices=devices8)
+
+
+def test_ddp_one_grad_allreduce_no_gathers(devices8):
+    mesh = make_mesh(MeshSpec(dp=8), devices=devices8)
+    hlo = _hlo(mesh, DDP())
+    c = counts(hlo)
+    # the C++-Reducer twin: gradient sync is all-reduce, nothing else
+    assert max_all_reduce_elems(hlo) >= NET_LARGEST_GRAD, c
+    assert "all-gather" not in c and "reduce-scatter" not in c, c
+
+
+def test_zero1_update_shards_and_gathers_params(zmesh):
+    hlo = _hlo(zmesh, ZeRO1())
+    c = counts(hlo)
+    # grads replicated (all-reduce), updated params re-broadcast from the
+    # opt shard via all-gather — one per sharded kernel
+    assert max_all_reduce_elems(hlo) >= NET_LARGEST_GRAD, c
+    assert c.get("all-gather", 0) >= 3, c
+
+
+def test_zero2_reduce_scatters_grads(zmesh):
+    hlo = _hlo(zmesh, ZeRO2())
+    # literal reduce-scatter (TPU) or all-reduce + shard-sized
+    # dynamic-slice (CPU pipeline) — either way the optimizer must
+    # consume shard-sized gradients
+    assert has_logical_reduce_scatter(hlo, NET_SHARD_ELEMS)
+    assert counts(hlo).get("all-gather", 0) >= 3
+
+
+def test_zero2_constraint_in_lowered_module(zmesh):
+    # the backend-independent fact: ZeRO-2 lowers MORE sharding
+    # constraints than ZeRO-1 (one per sharded grad kernel). If the grad
+    # constraint silently stopped being applied, both backends would
+    # quietly all-reduce and this is the test that notices.
+    def lowered(policy):
+        state, step, batch = _build_net(zmesh, policy)
+        with zmesh:
+            return step._jitted.lower(
+                state, batch, jnp.float32(1.0)
+            ).as_text()
+
+    marks = re.compile(r"sharding_constraint|@Sharding")
+    n1 = len(marks.findall(lowered(ZeRO1())))
+    n2 = len(marks.findall(lowered(ZeRO2())))
+    assert n2 >= n1 + 3, (n1, n2)
+
+
+def test_zero3_gathers_params_for_compute(zmesh):
+    hlo2 = _hlo(zmesh, ZeRO2())
+    hlo3 = _hlo(zmesh, ZeRO3())
+    # ZeRO-3 adds forward/backward param all-gathers on top of ZeRO-2's
+    # update-path gathers
+    assert (
+        counts(hlo3).get("all-gather", 0)
+        > counts(hlo2).get("all-gather", 0)
+    ), (counts(hlo2), counts(hlo3))
+    assert has_logical_reduce_scatter(hlo3, NET_SHARD_ELEMS)
+
+
+def test_tp_activation_allreduce_per_block(devices8):
+    mesh = make_mesh(MeshSpec(dp=2, tp=4), devices=devices8)
+    hlo = _hlo(mesh, TensorParallel(), build=_build_gpt)
+    c = counts(hlo)
+    # Megatron row-parallel projections psum activations: at least one
+    # all-reduce per transformer block beyond the dp grad sync
+    assert c.get("all-reduce", 0) >= GPT2Config.tiny().n_layer + 1, c
+
+
+def test_hybrid_tp_zero3_gathers_and_reduces(devices8):
+    mesh = make_mesh(MeshSpec(fsdp=2, tp=4), devices=devices8)
+    hlo = _hlo(mesh, tp_zero3(min_shard_size=1), build=_build_gpt)
+    c = counts(hlo)
+    # 2D layout: fsdp param all-gathers AND tp/grad reductions coexist
+    assert c.get("all-gather", 0) >= 1, c
+    assert c.get("all-reduce", 0) >= 1, c
+    assert collective_inventory(hlo), "no collectives at all?"
