@@ -165,6 +165,8 @@ class DataLoader:
         self.persistent_workers = bool(persistent_workers)
         self._pool = None  # live persistent executor, if any
         self._forwarded_epoch = None  # last epoch pushed to the transform
+        self._feeders: list = []  # live prefetch feeders (epoch-race guard)
+        self._warned_live_epoch = False
         self._pool_built_epoch = None  # transform epoch a live pool pickled
         self.dataset = dataset
         self.batch_size = batch_size
@@ -207,10 +209,43 @@ class DataLoader:
         tf = getattr(self.dataset, "transform", None)
         if tf is None or not hasattr(tf, "set_epoch"):
             return
+        # The transform's epoch is LIVE state shared with fetch workers —
+        # unlike the sampler order, which __iter__ snapshots. Moving it
+        # while a previous iteration's prefetch is still in flight applies
+        # the new epoch's augmentation to the old epoch's trailing
+        # batches. Detect and warn (once): drain or abandon the previous
+        # iterator before calling set_epoch()/iter(). (ADVICE r4.)
+        self._feeders = [t for t in self._feeders if self._feeder_live(t)]
+        if (
+            self._feeders
+            and self._forwarded_epoch is not None
+            and self._forwarded_epoch != self._epoch
+            and not self._warned_live_epoch
+        ):
+            self._warned_live_epoch = True
+            import warnings
+
+            warnings.warn(
+                f"transform epoch moved {self._forwarded_epoch} -> "
+                f"{self._epoch} while a previous iteration's prefetch is "
+                "still in flight; its trailing fetches will use the new "
+                "epoch's augmentation (sampler order is snapshotted per "
+                "iteration, transform state is not). Exhaust or drop the "
+                "previous iterator before set_epoch()/iter().",
+                RuntimeWarning,
+                stacklevel=3,
+            )
         tf.set_epoch(self._epoch)
         self._forwarded_epoch = self._epoch
         if self._pool is not None and self._pool_built_epoch != self._epoch:
             self.shutdown_workers()
+
+    @staticmethod
+    def _feeder_live(t) -> bool:
+        """A feeder is a hazard only while fetches can still run: alive
+        AND not yet fully drained (the drained flag is set before _END,
+        so a consumer that just finished list(loader) never counts)."""
+        return t.is_alive() and not t.graft_drained.is_set()
 
     def _index_batches(self):
         if self.sampler is not None:
@@ -379,6 +414,11 @@ class DataLoader:
                     continue
             return False
 
+        drained = threading.Event()  # set BEFORE _END: no fetch can
+        # still be in flight, so the epoch-race guard must not count a
+        # fully-drained feeder whose thread is merely not yet reaped
+        # (is_alive() alone races with the consumer seeing _END)
+
         def feeder():
             try:
                 from collections import deque
@@ -397,11 +437,15 @@ class DataLoader:
                     futs = pending.popleft()
                     if not put(self.collate_fn([f.result() for f in futs])):
                         return
+                drained.set()
                 put(_END)
             except BaseException as e:  # propagate to consumer
                 put((_ERR, e))
 
         t = threading.Thread(target=feeder, daemon=True)
+        t.graft_drained = drained
+        self._feeders = [th for th in self._feeders if self._feeder_live(th)]
+        self._feeders.append(t)
         t.start()
         try:
             while True:

@@ -54,6 +54,14 @@ class FeatLoss:
 
     ``FeatLoss()(outputs, targets)`` — callable like the reference's
     ``feat_loss`` (`Stoke-DDP.py:224`: ``loss=feat_loss``).
+
+    .. note:: round 4 switched the fixed-filter construction from
+       ``jax.random`` to host numpy (import hygiene: building a loss must
+       not initialize a backend), which changed the filter values for a
+       given ``seed``. Loss *curves* are therefore not numerically
+       comparable across that upgrade; convergence behavior and the
+       SR-quality ablation (BASELINE.md r2) are unaffected. See
+       MIGRATION.md.
     """
 
     def __init__(self, depths=(16, 32, 64), pixel_weight: float = 1.0, seed: int = 0):

@@ -149,3 +149,45 @@ def test_persistent_pool_restarts_on_epoch_change(tmp_path):
         )
     finally:
         loader.shutdown_workers()
+
+
+def test_live_prefetch_epoch_change_warns():
+    """Moving the transform epoch while a previous iteration's prefetch
+    is still in flight warns: trailing fetches of the old epoch would see
+    the new epoch's augmentation (ADVICE r4 — sampler order is
+    snapshotted per iteration, transform state is not)."""
+    import time
+    import warnings
+
+    from pytorch_distributedtraining_tpu.data import DataLoader
+
+    class _SlowDS(_StampDS):
+        def __getitem__(self, i):
+            time.sleep(0.05)  # keep the feeder alive across set_epoch
+            return super().__getitem__(i)
+
+    loader = DataLoader(_SlowDS(n=16), batch_size=2, num_workers=1)
+    loader.set_epoch(0)
+    it = iter(loader)
+    next(it)  # feeder running, queue partially drained
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        loader.set_epoch(7)
+    assert any("prefetch is still in flight" in str(x.message) for x in w), (
+        [str(x.message) for x in w]
+    )
+    list(it)  # drain so the feeder thread exits cleanly
+
+
+def test_epoch_change_after_drain_does_not_warn():
+    import warnings
+
+    from pytorch_distributedtraining_tpu.data import DataLoader
+
+    loader = DataLoader(_StampDS(n=8), batch_size=4, num_workers=1)
+    loader.set_epoch(0)
+    list(loader)  # fully drained; feeder exits
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        loader.set_epoch(1)
+    assert not [x for x in w if "prefetch" in str(x.message)]
