@@ -193,3 +193,50 @@ def test_hybrid_tp_zero3_gathers_and_reduces(devices8):
     assert c.get("all-gather", 0) >= 1, c
     assert c.get("all-reduce", 0) >= 1, c
     assert collective_inventory(hlo), "no collectives at all?"
+
+
+class TestInventoryParser:
+    """observe.hlo text-parser edge cases (no compilation involved)."""
+
+    HLO = "\n".join([
+        "  %all-reduce.10 = (f32[64]{0}, f32[5,5,3,64]{3,2,1,0}) "
+        "all-reduce(%a, %b), replica_groups=[1,8]<=[8]",
+        "  %ag = bf16[3,3,8,32]{3,2,1,0} all-gather(%c), dimensions={2}",
+        "  %ars = f32[100]{0} all-reduce-start(%d)",
+        "  %rs = f32[2304]{0} reduce-scatter(%e)",
+        "  %ds = f32[2304]{0} dynamic-slice(%f, %i0), "
+        "dynamic_slice_sizes={2304}",
+        "  %noise = f32[9999]{0} add(%g, %h)",
+    ])
+
+    def test_kinds_and_sizes(self):
+        inv = collective_inventory(self.HLO)
+        kinds = [op.kind for op in inv]
+        assert kinds == [
+            "all-reduce", "all-gather", "all-reduce", "reduce-scatter",
+        ]
+        # tuple-shaped combined collective reports its largest member
+        assert inv[0].max_elems == 5 * 5 * 3 * 64
+        assert inv[1].max_elems == 3 * 3 * 8 * 32
+
+    def test_counts_and_max(self):
+        assert counts(self.HLO) == {
+            "all-reduce": 2, "all-gather": 1, "reduce-scatter": 1,
+        }
+        assert max_all_reduce_elems(self.HLO) == 4800
+
+    def test_logical_reduce_scatter_forms(self):
+        # literal op present
+        assert has_logical_reduce_scatter(self.HLO, 1)
+        # unfused CPU form: all-reduce + shard-sized dynamic-slice
+        unfused = "\n".join(
+            l for l in self.HLO.splitlines() if "reduce-scatter" not in l
+        )
+        assert has_logical_reduce_scatter(unfused, 2304)
+        assert not has_logical_reduce_scatter(unfused, 1234)
+        # no reduction at all
+        assert not has_logical_reduce_scatter("%x = f32[4] add(%a, %b)", 4)
+
+    def test_scalar_shapes(self):
+        inv = collective_inventory("%r = f32[] all-reduce(%x)")
+        assert inv[0].max_elems == 1
