@@ -87,10 +87,12 @@ full_chain() {
   # bench.py's own wait-then-retry (round-5 envelope) rides mid-stage
   # pool flaps instead of dying to the outer timeout (review finding r5)
   run bench 1300 env GRAFT_BENCH_TOTAL=1200 python bench.py
+  # dispatch-cost decomposition for the scan anomaly (VERDICT #4) —
+  # before facade because it is 3x cheaper and a short window (17 min
+  # observed) should still capture it
+  run dispatch_probe 300 python benchmarks/dispatch_probe.py
   # verbose-path facade parity with the async fetcher (VERDICT #3)
   run facade 900 python benchmarks/facade_bench.py
-  # dispatch-cost decomposition for the scan anomaly (VERDICT #4)
-  run dispatch_probe 300 python benchmarks/dispatch_probe.py
   run bench_scan_k10 540 env GRAFT_BENCH_KNOBS=0 GRAFT_BENCH_TOTAL=500 GRAFT_BENCH_STEPS=200 GRAFT_BENCH_OPT=fused GRAFT_BENCH_LOOP=scan GRAFT_BENCH_SCAN_K=10 python bench.py
   run bench_scan_k25 540 env GRAFT_BENCH_KNOBS=0 GRAFT_BENCH_TOTAL=500 GRAFT_BENCH_STEPS=200 GRAFT_BENCH_OPT=fused GRAFT_BENCH_LOOP=scan GRAFT_BENCH_SCAN_K=25 python bench.py
   run bench_scan_full 540 env GRAFT_BENCH_KNOBS=0 GRAFT_BENCH_TOTAL=500 GRAFT_BENCH_STEPS=200 GRAFT_BENCH_OPT=fused GRAFT_BENCH_LOOP=scan python bench.py
