@@ -23,7 +23,7 @@ No bucket loops, no hooks, no wrapper forward: one compiled step.
 from .policy import DDP, ZeRO1, ZeRO2, ZeRO3, OSS, ShardedDDP, FSDP, Policy, policy_from_flags
 from .spec import leaf_spec, tree_specs, shard_axis
 from .state import TrainState, create_train_state
-from .step import TrainStep, EvalStep, MultiStep
+from .step import TrainStep, EvalStep, MultiStep, tune_multi_step_k
 from .compressed import CompressedGradStep
 from .tensor import MEGATRON_RULES, TensorParallel, tp_zero1, tp_zero3
 from .pipeline import pipeline_apply, stack_stage_params, unstack_stage_params
@@ -46,6 +46,7 @@ __all__ = [
     "TrainStep",
     "EvalStep",
     "MultiStep",
+    "tune_multi_step_k",
     "CompressedGradStep",
     "MEGATRON_RULES",
     "TensorParallel",

@@ -464,6 +464,71 @@ class MultiStep:
             return self._jitted(state, batches, jnp.float32(lr_factor))
 
 
+def tune_multi_step_k(
+    step: TrainStep,
+    state: TrainState,
+    batch,
+    ks=(1, 2, 5, 10),
+    steps_per_arm: int = 20,
+    lr_factor: float = 1.0,
+):
+    """Measure K-steps-per-dispatch empirically and pick the winner.
+
+    Whether :class:`MultiStep` pays depends on the host/link, not the
+    model: on a dispatch-bound host it should win by ~k, yet the only
+    on-chip measurement of the pattern so far was ~90x SLOWER through a
+    remote-dispatch tunnel (BASELINE.md r4 scan anomaly). Don't guess —
+    measure each candidate k on the live backend and keep the best:
+
+        best_k, rates, state = tune_multi_step_k(step, state, batch)
+        multi = MultiStep(step, best_k) if best_k > 1 else step
+
+    Costs one compile per candidate k plus ``steps_per_arm`` real
+    optimizer steps per arm (the returned ``state`` has advanced; thread
+    it back into training — with ``donate=True`` steps the input state
+    is consumed either way). Pass the loop's current ``lr_factor`` so
+    the tuning steps train at the schedule's real rate, not full LR.
+    Timing is wall-clock per completed window with a final host fetch,
+    so tunnel memoization or an under-blocking ``block_until_ready``
+    cannot fake a fast arm.
+
+    Returns ``(best_k, {k: steps_per_sec}, state)``. On a non-finite
+    loss the raised ``RuntimeError`` carries the last-good advanced
+    state as ``err.state`` (with donated steps the input state is
+    already consumed; this keeps the run resumable without a
+    checkpoint).
+    """
+    import time as _time
+
+    rates: dict[int, float] = {}
+    with step.mesh:
+        for k in ks:
+            k = int(k)
+            n_calls = max(1, steps_per_arm // k)
+            if k == 1:
+                runner, fed = step, batch
+            else:
+                runner = MultiStep(step, k)
+                fed = jax.tree.map(
+                    lambda x: jnp.broadcast_to(x[None], (k,) + x.shape),
+                    batch,
+                )
+            state, metrics = runner(state, fed, lr_factor)  # compile+warm
+            jax.block_until_ready(metrics["loss"])
+            t0 = _time.perf_counter()
+            for _ in range(n_calls):
+                state, metrics = runner(state, fed, lr_factor)
+            # host fetch: transitively waits on every step of the arm
+            last = jnp.ravel(metrics["loss"])[-1]
+            if not bool(jnp.isfinite(last)):
+                err = RuntimeError(f"non-finite loss while tuning k={k}")
+                err.state = state  # donated input is gone; keep this one
+                raise err
+            rates[k] = k * n_calls / (_time.perf_counter() - t0)
+    best_k = max(rates, key=rates.get)
+    return best_k, rates, state
+
+
 class EvalStep:
     """Compiled forward+metrics step (validation loop,
     `Stoke-DDP.py:101-128`).

@@ -145,3 +145,25 @@ def test_stack_windows_device_batches(devices8):
         state, m = multi(state, stacked)
         n += 1
     assert n == 1 and int(state.step) == 2
+
+
+def test_tune_multi_step_k(devices8):
+    """The tuner measures each candidate k on the live backend, returns
+    finite rates for all arms, a best_k among the candidates, and a
+    still-trainable advanced state (don't guess whether K-per-dispatch
+    pays — the r4 on-chip anomaly showed guessing wrong costs 90x)."""
+    from pytorch_distributedtraining_tpu.parallel import tune_multi_step_k
+
+    mesh, state, step = _build(devices8, DDP())
+    lo, hr = _batches(1)
+    batch = (lo[0], hr[0])
+    best_k, rates, state2 = tune_multi_step_k(
+        step, state, batch, ks=(1, 2), steps_per_arm=4
+    )
+    assert set(rates) == {1, 2}
+    assert all(r > 0 and np.isfinite(r) for r in rates.values())
+    assert best_k in (1, 2) and rates[best_k] == max(rates.values())
+    # the returned state advanced by every tuning step and keeps training
+    assert int(state2.step) == 4 + 1 + 4 + 2  # per arm: warm + timed calls
+    state3, metrics = step(state2, batch)
+    assert np.isfinite(float(metrics["loss"]))
