@@ -1,0 +1,70 @@
+"""Canonical flagship-config builder for the benchmark suite.
+
+One place for the SwinIR-S x2 / batch-18 / 64x64 / bf16 / FusedAdamW
+step the headline measures (`/root/reference/Stoke-DDP.py:206-208,159`),
+so a config change cannot silently leave one bench measuring a stale
+setup. `bench.py` deliberately keeps its own knob-parameterized copy
+(env > bench_knobs.json > default resolution is its whole job);
+`facade_bench.py` builds through the Stoke facade on purpose (that IS
+its measured surface). New benches should start here.
+"""
+
+from __future__ import annotations
+
+
+def make_flagship_step(cpu_self_test: bool = False, policy=None):
+    """Build (mesh, state, step, batch) for the flagship train step.
+
+    ``cpu_self_test`` shrinks the model/batch so envelope self-tests run
+    in seconds off-chip. Returns device-placed batch tuples ready to
+    feed the compiled step.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributedtraining_tpu import optim
+    from pytorch_distributedtraining_tpu.losses import mse_loss
+    from pytorch_distributedtraining_tpu.models import SwinIR
+    from pytorch_distributedtraining_tpu.parallel import (
+        DDP,
+        TrainStep,
+        create_train_state,
+    )
+    from pytorch_distributedtraining_tpu.precision import Policy as Precision
+    from pytorch_distributedtraining_tpu.runtime.mesh import (
+        MeshSpec,
+        make_mesh,
+    )
+
+    batch_n, patch = (2, 16) if cpu_self_test else (18, 64)
+    model_kw = (
+        dict(depths=[2], embed_dim=12, num_heads=[2], img_size=16,
+             window_size=4)
+        if cpu_self_test
+        else {}
+    )
+    policy = policy if policy is not None else DDP()
+    mesh = make_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
+    model = SwinIR(dtype=jnp.bfloat16, **model_kw)
+    tx = optim.FusedAdamW(lr=5e-4, clip_grad_norm=0.1)
+
+    def loss_fn(params, batch, rng, model_state):
+        lr_img, hr_img = batch
+        return mse_loss(model.apply({"params": params}, lr_img), hr_img), {}
+
+    state, shardings = create_train_state(
+        init_fn=lambda rng: (
+            model.init(rng, jnp.zeros((1, patch, patch, 3)))["params"], {},
+        ),
+        tx=tx, mesh=mesh, policy=policy,
+    )
+    step = TrainStep(
+        loss_fn, tx, mesh, policy, precision=Precision(),
+        state_shardings=shardings, extra_metrics=False, donate=True,
+    )
+    rng = np.random.default_rng(0)
+    hr = rng.random((batch_n, 2 * patch, 2 * patch, 3)).astype(np.float32)
+    lr_img = hr.reshape(batch_n, patch, 2, patch, 2, 3).mean(axis=(2, 4))
+    batch = (jax.device_put(lr_img), jax.device_put(hr))
+    return mesh, state, step, batch, batch_n

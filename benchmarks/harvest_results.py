@@ -28,6 +28,7 @@ STAGES = [
     ("bench_scan_k10", "bench.py, fused + lax.scan k=10 per dispatch"),
     ("bench_scan_k25", "bench.py, fused + lax.scan k=25 per dispatch"),
     ("bench_scan_full", "bench.py, fused + lax.scan whole window per dispatch"),
+    ("tune_probe", "tune_multi_step_k on the flagship step (tune_probe.py)"),
     ("ladder_all", "five-config ladder, 200-step best-of-3 (ladder.py --all)"),
     ("attn8k", "flash attention at T=8k/16k crossover hunt (attn_bench.py)"),
     ("bench_s200", "bench.py, committed knobs, STEPS=200 sustained"),

@@ -99,6 +99,9 @@ full_chain() {
   # all three offload arms incl. param offload (VERDICT #8) — the raised
   # budget the r4 chain never granted
   run offload 1100 python benchmarks/offload_smoke.py
+  # the user-facing tuner API on the flagship step (should resolve to
+  # k=1 if the scan anomaly persists — that resolution is the feature)
+  run tune_probe 700 python benchmarks/tune_probe.py
   # five-config ladder at sustained 200-step best-of-3 (VERDICT #6)
   run ladder_all 1800 python benchmarks/ladder.py --all --steps 200
   # Pallas crossover hunt at long sequence (VERDICT #9)
