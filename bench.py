@@ -146,6 +146,40 @@ def _emit_error(reason: str) -> None:
             record["last_measured"] = json.load(fh)
     except Exception:
         pass
+    # more context: the outage watcher's longer horizon — its log shows
+    # how long the pool has been down around this capture, beyond this
+    # run's own probes (best-effort; absent when no watcher ran)
+    try:
+        wlog = os.path.join(
+            os.environ.get(
+                "GRAFT_RESULTS",
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "benchmarks", "results_r5",
+                ),
+            ),
+            "watch.log",
+        )
+        # only a LIVE watcher's view counts: a stale log from an old
+        # session must not attribute an unrelated failure to an outage
+        # that ended long ago (two probe periods of slack)
+        if time.time() - os.path.getmtime(wlog) < 600:
+            with open(wlog) as fh:
+                lines = [l.strip() for l in fh if "pool" in l.lower()]
+            down = 0
+            for line in reversed(lines):
+                if "pool down" in line.lower():
+                    down += 1
+                else:
+                    break
+            if down >= 2:
+                record["watcher_context"] = (
+                    f"outage watcher saw the pool down for {down} "
+                    f"consecutive probes (~4 min apart), since "
+                    f"{lines[-down][1:9]} UTC"
+                )
+    except Exception:
+        pass
     os.write(1, ("\n" + json.dumps(record) + "\n").encode())
     os._exit(1)
 

@@ -41,7 +41,8 @@ fi
 export JAX_COMPILATION_CACHE_DIR="$_CDIR"
 export PYTHONPATH="$PWD:${PYTHONPATH:-}"
 OUT="$BASE"  # per-window subdir assigned in the loop below
-log() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$BASE/watch.log"; }
+# -u: bench.py's error record quotes these timestamps as UTC
+log() { echo "[$(date -u +%H:%M:%S)] $*" | tee -a "$BASE/watch.log"; }
 
 pool_up() {
   # stderr goes to its own file so library log lines can neither satisfy
