@@ -17,7 +17,7 @@ import time
 import numpy as np
 
 import _bootstrap  # noqa: F401  (repo root on sys.path)
-from _roofline import guard
+from _roofline import guard, verify_finite
 
 
 def main():
@@ -59,14 +59,10 @@ def main():
             out = wrapped(eps[i], q, k, v)
         jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / STEPS
-        # untimed verification fetch: proves the final rep really executed
-        # and is finite (block_until_ready through the experimental tunnel
-        # under-blocked in the r4 decode artifact). Untimed because one
-        # ~100 ms RTT would swamp these µs-scale reps; the roofline guard
-        # bounds any residual over-report.
-        probe = float(jnp.asarray(jax.tree.leaves(out)[0]).ravel()[0])
-        if not np.isfinite(probe):
-            raise SystemExit(f"non-finite output after timing: {probe}")
+        verify_finite(
+            float(jnp.asarray(jax.tree.leaves(out)[0]).ravel()[0]),
+            "attention output",
+        )
         return dt
 
     raw = os.environ.get("GRAFT_ATTN_SIZES", "512,1024,2048,4096")

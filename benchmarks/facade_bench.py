@@ -22,7 +22,7 @@ import os
 import time
 
 import _bootstrap  # noqa: F401  (repo root on sys.path)
-from _roofline import guard
+from _roofline import guard, verify_finite
 
 CPU_SELF_TEST = os.environ.get("GRAFT_BENCH_PLATFORM") == "cpu"
 STEPS = max(1, int(
@@ -114,14 +114,7 @@ def main() -> None:
             state, metrics = step(state, batch)
         jax.block_until_ready(metrics["loss"])
         raw_dt = time.perf_counter() - t0
-        # untimed verification fetch: the loss chains through every step,
-        # so a real finite host value proves the window executed (the
-        # experimental tunnel under-blocked block_until_ready in the r4
-        # decode artifact); untimed so the ~100 ms RTT doesn't distort
-        # the window, with the roofline guard bounding any residual lie
-        final = float(metrics["loss"])
-        if not np.isfinite(final):
-            raise SystemExit(f"non-finite loss after trainstep arm: {final}")
+        verify_finite(float(metrics["loss"]), "trainstep-arm loss")
     raw_ips = BATCH * STEPS / raw_dt
 
     # -- path B: the reference-shaped facade loop (Stoke-DDP.py:73-86) ----
@@ -196,10 +189,8 @@ def main() -> None:
     stoke_model.verbose = False
     verbose_ips = BATCH * STEPS / verbose_dt
     # covers both facade windows: the loss chains through the quiet AND
-    # verbose loops of the same Stoke instance (untimed, see above)
-    final = float(synced)
-    if not np.isfinite(final):
-        raise SystemExit(f"non-finite loss after facade arms: {final}")
+    # verbose loops of the same Stoke instance
+    verify_finite(float(synced), "facade-arm loss")
 
     # Roofline guard (VERDICT r4 #5): same bound as bench.py — SwinIR-S x2
     # trains at ~21 GFLOP/image and no v5e-class chip exceeds 1 PFLOP/s

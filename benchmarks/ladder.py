@@ -47,12 +47,10 @@ def _timed_steps(step, state, batch, n_steps, warmup):
             state, metrics = step(state, batch)
         jax.block_until_ready(metrics["loss"])
         best = min(best, time.perf_counter() - t0)
-    # untimed verification fetch: the loss transitively depends on every
-    # step (state chains), so a real host value proves the whole window
-    # executed — guarding against block_until_ready returning early on
-    # the experimental tunnel (the r4 decode artifact). A timed fetch
-    # would distort short windows by the ~100 ms tunnel RTT, so it stays
-    # outside the clock; the roofline guard bounds any residual lie.
+    # untimed verification fetch (see _roofline.verify_finite): the loss
+    # chains through every step, so this proves the window executed.
+    # RuntimeError (not the helper's SystemExit) keeps main()'s
+    # per-config isolation able to save the other rungs.
     final_loss = float(metrics["loss"])
     if not np.isfinite(final_loss):
         raise RuntimeError(f"non-finite loss after timing: {final_loss}")

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 import _bootstrap  # noqa: F401  (repo root on sys.path)
-from _roofline import guard
+from _roofline import guard, verify_finite
 
 from pytorch_distributedtraining_tpu import optim
 from pytorch_distributedtraining_tpu.losses import mse_loss
@@ -171,9 +171,7 @@ def measure_peak():
         out = chained(out, b)  # feed back: reps chain, args never repeat
         jax.block_until_ready(out)
         best = min(best, time.perf_counter() - t0)
-    probe = float(out[0, 0])  # untimed verification fetch
-    if not np.isfinite(probe):
-        raise SystemExit(f"peak probe produced non-finite output: {probe}")
+    verify_finite(float(out[0, 0]), "peak-probe output")
     tflops = 2 * n * n * n * k_chain / best / 1e12
     # the denominator of every MFU line must itself be physical
     guard(
