@@ -23,16 +23,17 @@ from __future__ import annotations
 VIOLATION_PREFIX = "ROOFLINE VIOLATION"
 
 
-def verify_finite(value: float, label: str) -> float:
+def verify_finite(value: float, label: str, exc=SystemExit) -> float:
     """Untimed post-window verification: a real finite host value proves
     the timed work executed (block_until_ready through the experimental
     tunnel under-blocked in the r4 decode artifact). Callers fetch AFTER
     stopping the clock — one ~100 ms RTT would distort short windows —
-    and the roofline guard bounds any residual lie."""
+    and the roofline guard bounds any residual lie. ``exc`` lets callers
+    with per-arm isolation (ladder) raise a catchable error instead."""
     import math
 
     if not math.isfinite(value):
-        raise SystemExit(f"non-finite {label} after timing: {value}")
+        raise exc(f"non-finite {label} after timing: {value}")
     return value
 
 

@@ -47,13 +47,12 @@ def _timed_steps(step, state, batch, n_steps, warmup):
             state, metrics = step(state, batch)
         jax.block_until_ready(metrics["loss"])
         best = min(best, time.perf_counter() - t0)
-    # untimed verification fetch (see _roofline.verify_finite): the loss
-    # chains through every step, so this proves the window executed.
-    # RuntimeError (not the helper's SystemExit) keeps main()'s
-    # per-config isolation able to save the other rungs.
-    final_loss = float(metrics["loss"])
-    if not np.isfinite(final_loss):
-        raise RuntimeError(f"non-finite loss after timing: {final_loss}")
+    # untimed verification (the loss chains through every step);
+    # RuntimeError keeps main()'s per-config isolation able to save the
+    # other rungs
+    from _roofline import verify_finite
+
+    verify_finite(float(metrics["loss"]), "loss", exc=RuntimeError)
     return best
 
 
