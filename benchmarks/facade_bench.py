@@ -214,6 +214,14 @@ def main() -> None:
                 bad_arms.add(arm)
 
     ratio = facade_ips / raw_ips
+    # vs_baseline is the facade/trainstep ratio: if EITHER of those arms
+    # failed the roofline guard the ratio is built on a broken number —
+    # publish null, not a value that looks measured (ADVICE r5 #3)
+    vs_baseline = (
+        round(ratio, 3)
+        if not ({"trainstep", "facade"} & bad_arms)
+        else None
+    )
     for metric, value, unit, arms in (
         ("trainstep_images_per_sec", raw_ips, "images/sec/chip",
          {"trainstep"}),
@@ -230,7 +238,7 @@ def main() -> None:
             "metric": metric,
             "value": round(value, 3),
             "unit": unit,
-            "vs_baseline": round(ratio, 3),
+            "vs_baseline": vs_baseline,
         }))
     if bad_arms:
         raise SystemExit(5)

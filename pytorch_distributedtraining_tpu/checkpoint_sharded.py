@@ -29,16 +29,48 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from .resilience.faults import fault_point
+from .resilience.outage import OutageClass, RetryPolicy, classify_exception
+
 
 def _abs(path: str) -> str:
     return os.path.abspath(os.path.expanduser(path))
 
 
-def save_sharded(path: str, state: Any, *, force: bool = False) -> str:
-    """Write ``state`` (any pytree of jax.Arrays) as a sharded checkpoint."""
+def save_sharded(
+    path: str,
+    state: Any,
+    *,
+    force: bool = False,
+    retry: "RetryPolicy | None" = None,
+) -> str:
+    """Write ``state`` (any pytree of jax.Arrays) as a sharded checkpoint.
+
+    Transient I/O failures (EIO on a flaky NFS mount, connection resets to
+    object storage) are retried with backoff per the shared classifier;
+    anything it cannot call an outage propagates immediately. The retry is
+    per-host best-effort: a *partial* multi-host failure still needs the
+    launcher's elastic restart (the other hosts already completed their
+    collective write); the common all-hosts-shared-FS hiccup recovers here.
+    """
     path = _abs(path)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, state, force=force)
+    policy = retry or RetryPolicy(
+        attempts=int(os.environ.get("GRAFT_CKPT_WRITE_ATTEMPTS", "3")),
+        base_delay_s=0.5,
+        max_delay_s=10.0,
+    )
+
+    def _write():
+        # chaos site: the I/O error surfaces where a real one would — at
+        # the actual write, after the checkpointer is constructed
+        fault_point("checkpoint.write", path=path)
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, state, force=force)
+
+    policy.run(
+        _write,
+        retry_on=lambda e: classify_exception(e) is OutageClass.OUTAGE,
+    )
     return path
 
 
@@ -136,6 +168,9 @@ class CheckpointManager:
             self._async_ckptr.wait_until_finished()
             self._gc()
             path = self._step_dir(step)
+            # same chaos site as the sync path; async initiation errors
+            # surface here, commit errors at wait_until_finished
+            fault_point("checkpoint.write", path=path)
             self._async_ckptr.save(path, state, force=True)
             return path
         path = save_sharded(self._step_dir(step), state, force=True)
@@ -168,6 +203,11 @@ class CheckpointManager:
         """Save when on-schedule or preempted anywhere; returns the path if
         saved. In multi-host runs every process must call this every step
         (it contains the preemption agreement collective)."""
+        # chaos site: an action="sigterm" rule here IS a mid-step preemption
+        # — the signal lands on this process before the agreement allgather
+        # below, so the drill exercises the exact flag → agree → forced
+        # durable save path a real SIGTERM takes
+        fault_point("train.preempt", step=step)
         scheduled = (
             self.save_every > 0 and step > 0 and step % self.save_every == 0
         )

@@ -24,6 +24,7 @@ from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 
 import numpy as np
 
+from ..resilience.faults import fault_point
 from .sampler import DistributedSampler
 
 # Process-worker state: the dataset is shipped ONCE per worker via the
@@ -39,6 +40,9 @@ def _process_worker_init(dataset):
 
 
 def _process_worker_fetch(i):
+    # chaos site: the plan crosses the spawn boundary via GRAFT_FAULT_PLAN
+    # in the inherited env, so worker-crash drills work on real workers
+    fault_point("loader.fetch", index=i)
     return _WORKER_DATASET[i]
 
 
@@ -354,9 +358,13 @@ class DataLoader:
         """Executor + fetch fn: threads by default, processes when a
         multiprocessing context was requested (the GIL escape hatch)."""
         if self._mp_context is None:
+            def _thread_fetch(i):
+                fault_point("loader.fetch", index=i)
+                return self.dataset[i]
+
             return (
                 ThreadPoolExecutor(max_workers=self.num_workers),
-                lambda i: self.dataset[i],
+                _thread_fetch,
                 False,
             )
         if self._pool is not None:

@@ -90,22 +90,112 @@ def max_all_reduce_elems(hlo_text: str) -> int:
     return max(sizes, default=0)
 
 
+# ops that forward their first operand's value unchanged (modulo
+# layout/shape/dtype) — a dynamic-slice reading *through* one of these
+# still slices the all-reduce's result
+_PASSTHROUGH_OPS = (
+    "get-tuple-element(",
+    "bitcast(",
+    "bitcast-convert(",
+    "copy(",
+    "reshape(",
+    "transpose(",
+    "convert(",
+    # async completion: -done's first operand is the -start's token and its
+    # value is the reduction result
+    "all-reduce-done(",
+)
+
+_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.-]+)\s*=")
+_PCT_NAME_RE = re.compile(r"%([\w.-]+)")
+
+
+def _first_operand(line: str, op_token: str) -> str | None:
+    """Name of the first operand of ``op_token`` on ``line``.
+
+    Handles both HLO text styles: the long form prints ``%name`` (possibly
+    after an inline tuple-type annotation), the short form prints bare
+    names with no types.
+    """
+    after = line.split(op_token, 1)[1]
+    m = _PCT_NAME_RE.search(after)
+    if m is not None:
+        return m.group(1)
+    tok = after.split(",")[0].split(")")[0].strip()
+    return tok or None
+
+
 def has_logical_reduce_scatter(hlo_text: str, shard_elems: int) -> bool:
     """True when the module reduce-scatters — literally, or in the CPU
-    pipeline's unfused form (an all-reduce whose consumers dynamic-slice
-    down to ``shard_elems``-sized shards)."""
+    pipeline's unfused form: an all-reduce whose result (possibly through
+    get-tuple-element / bitcast / reshape-style pass-through ops) is
+    ``dynamic-slice``'d down to a ``shard_elems``-sized shard.
+
+    The slice must actually *read the all-reduce's output*: a module that
+    happens to contain some unrelated shard-sized dynamic-slice (an
+    embedding lookup, an all-gather window) plus a full-tensor all-reduce
+    is exactly the GSPMD-backed-off-to-replication pattern this audit
+    exists to catch, and must return False.
+    """
     inv = collective_inventory(hlo_text)
     if any(op.kind == "reduce-scatter" for op in inv):
         return True
     if not any(op.kind == "all-reduce" for op in inv):
         return False
+
+    # pass 1 (HLO prints def-before-use within a computation): seed with
+    # all-reduce result names, propagate through pass-through ops, and
+    # record every shard-sized dynamic-slice plus every fusion call —
+    # XLA:CPU routinely fuses the slice, so the chain is
+    # all-reduce → fusion(operands incl. partition-id) → body dynamic-slice
+    ar_names: set[str] = set()
+    ds_comps: list[tuple[str, str, int]] = []  # (computation, operand, elems)
+    fusion_calls: list[tuple[list[str], str]] = []  # (operands, called comp)
+    comp = ""
     for line in hlo_text.splitlines():
-        if "dynamic-slice(" not in line:
+        if line.rstrip().endswith("{") and "->" in line:
+            comp = (line.split("(")[0].replace("ENTRY", "").strip()
+                    .lstrip("%"))
             continue
-        lhs = line.split("dynamic-slice(")[0]
-        if any(_elems(g) == shard_elems for g in _SHAPE_RE.findall(lhs)):
+        d = _DEF_RE.match(line)
+        if d is None:
+            continue
+        name = d.group(1)
+        m = _OP_RE.search(line)
+        if m is not None and m.group(1) == "all-reduce":
+            ar_names.add(name)
+            continue
+        for op_token in _PASSTHROUGH_OPS:
+            if op_token in line:
+                src = _first_operand(line, op_token)
+                if src in ar_names:
+                    ar_names.add(name)
+                break
+        if " fusion(" in line:
+            args = line.split(" fusion(", 1)[1].split("kind=")[0]
+            called = re.search(r"calls=%?([\w.$-]+)", line)
+            fusion_calls.append(
+                (_PCT_NAME_RE.findall(args), called.group(1) if called else "")
+            )
+        if "dynamic-slice(" in line:
+            lhs = line.split("dynamic-slice(")[0]
+            if any(_elems(g) == shard_elems for g in _SHAPE_RE.findall(lhs)):
+                op_name = _first_operand(line, "dynamic-slice(")
+                ds_comps.append((comp, op_name or "", _elems("1")))
+
+    # pass 2: a shard-sized slice counts when it reads an all-reduce result
+    # directly, or sits in a fusion body whose caller feeds it one
+    # (fusion-granularity precision: good enough to reject slices in
+    # fusions with no reduction input at all — the coincidental case)
+    for _, operand, _ in ds_comps:
+        if operand in ar_names:
             return True
-    return False
+    ar_fed = {
+        called
+        for operands, called in fusion_calls
+        if called and any(o in ar_names for o in operands)
+    }
+    return any(comp in ar_fed for comp, _, _ in ds_comps)
 
 
 def counts(hlo_text: str) -> dict[str, int]:

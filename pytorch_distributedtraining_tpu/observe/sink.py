@@ -14,6 +14,8 @@ import os
 import time
 from typing import Any
 
+from ..resilience.outage import RetryPolicy
+
 
 def _is_rank0() -> bool:
     import jax
@@ -72,6 +74,7 @@ class WandbSink(MetricsSink):
         config: dict | None = None,
         retry_interval: float = 10.0,
         max_retries: int = 3,
+        retry_policy: "RetryPolicy | None" = None,
         **init_kwargs,
     ):
         self._run = None
@@ -79,15 +82,26 @@ class WandbSink(MetricsSink):
             return
         import wandb  # noqa: F811
 
-        for attempt in range(max_retries):
-            try:
-                self._run = wandb.init(project=project, config=config, **init_kwargs)
-                break
-            except Exception:
-                print("Retrying")
-                time.sleep(retry_interval)
-        else:
-            raise RuntimeError(f"wandb.init failed after {max_retries} attempts")
+        # (retry_interval, max_retries) map onto the shared RetryPolicy with
+        # a flat schedule, preserving the reference's historical semantics;
+        # pass retry_policy for exponential backoff + jitter
+        policy = retry_policy or RetryPolicy(
+            attempts=max_retries,
+            base_delay_s=retry_interval,
+            multiplier=1.0,
+            jitter_frac=0.0,
+        )
+        try:
+            self._run = policy.run(
+                lambda: wandb.init(
+                    project=project, config=config, **init_kwargs
+                ),
+                on_retry=lambda i, e, d: print("Retrying"),
+            )
+        except Exception as e:
+            raise RuntimeError(
+                f"wandb.init failed after {policy.attempts} attempts"
+            ) from e
         self._wandb = wandb
 
     def log(self, metrics, step=None):

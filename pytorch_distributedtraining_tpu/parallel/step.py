@@ -493,10 +493,11 @@ def tune_multi_step_k(
     cannot fake a fast arm.
 
     Returns ``(best_k, {k: steps_per_sec}, state)``. On a non-finite
-    loss the raised ``RuntimeError`` carries the last-good advanced
-    state as ``err.state`` (with donated steps the input state is
-    already consumed; this keeps the run resumable without a
-    checkpoint).
+    loss the raised ``RuntimeError`` carries ``err.state``: a snapshot
+    of the state from *before* the failing arm — true last-good, never
+    advanced through the NaN-poisoned steps (with donated steps the
+    input state is already consumed; this keeps the run resumable
+    without a checkpoint).
     """
     import time as _time
 
@@ -505,6 +506,12 @@ def tune_multi_step_k(
         for k in ks:
             k = int(k)
             n_calls = max(1, steps_per_arm // k)
+            # snapshot BEFORE the arm touches the state: if this arm
+            # diverges, every step inside it is suspect — handing back the
+            # advanced (NaN-poisoned) state would poison the resumed run.
+            # jnp.copy keeps each leaf's sharding; the arm's donated steps
+            # consume `state`, never the snapshot.
+            snapshot = jax.tree.map(jnp.copy, state)
             if k == 1:
                 runner, fed = step, batch
             else:
@@ -522,8 +529,9 @@ def tune_multi_step_k(
             last = jnp.ravel(metrics["loss"])[-1]
             if not bool(jnp.isfinite(last)):
                 err = RuntimeError(f"non-finite loss while tuning k={k}")
-                err.state = state  # donated input is gone; keep this one
+                err.state = snapshot  # pre-arm state: last-good by construction
                 raise err
+            del snapshot
             rates[k] = k * n_calls / (_time.perf_counter() - t0)
     best_k = max(rates, key=rates.get)
     return best_k, rates, state

@@ -1,0 +1,63 @@
+"""Resilience: fault injection, outage classification, resilient capture.
+
+The reference stack's robustness contract is implicit (elastic restarts,
+rendezvous retry, preemption save — SURVEY §5) and was never adversarially
+exercised; five rounds of benchmark captures died to pool outages because
+every layer classified and retried failures its own way. This package makes
+the contract explicit and shared:
+
+- :mod:`.faults` — a deterministic fault-injection harness
+  (:class:`FaultPlan` + :func:`fault_point`): env/JSON-driven failures at
+  named sites threaded through the launcher, rendezvous, data loader,
+  checkpoint writer, and bench capture pipeline, so every recovery path has
+  a repeatable chaos test instead of hoping.
+- :mod:`.outage` — ONE outage classifier (:func:`classify`,
+  :func:`classify_exception`) plus :class:`RetryPolicy` (exponential
+  backoff + deterministic jitter) and :class:`CircuitBreaker` (half-open
+  probes), reused by ``bench.py``, the launcher's restart monitor, and the
+  W&B sink — no more ad-hoc sentinel string matching per call site.
+- :mod:`.capture` — the bench capture state machine
+  (PROBE → CAPTURE → RIDE_OUTAGE → FALLBACK → EMIT) and the structured
+  FALLBACK artifact builder: a pool outage degrades to an honest
+  provenance-flagged record carrying the last-good on-chip number and a
+  CPU-envelope measurement, never a bare value-0.0 artifact.
+
+Everything here is stdlib-only at import time: the bench parent (which must
+stay jax-free) and spawn-context loader workers both import it.
+"""
+
+from .capture import (
+    CaptureMachine,
+    CaptureState,
+    build_fallback_record,
+)
+from .faults import (
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    fault_point,
+    install_plan,
+)
+from .outage import (
+    CircuitBreaker,
+    OutageClass,
+    RetryPolicy,
+    classify,
+    classify_exception,
+)
+
+__all__ = [
+    "CaptureMachine",
+    "CaptureState",
+    "CircuitBreaker",
+    "FaultPlan",
+    "FaultRule",
+    "InjectedFault",
+    "OutageClass",
+    "RetryPolicy",
+    "build_fallback_record",
+    "classify",
+    "classify_exception",
+    "fault_point",
+    "install_plan",
+]

@@ -1,0 +1,128 @@
+"""Bench capture state machine + structured FALLBACK artifact builder.
+
+Five rounds produced zero green ``BENCH_r*.json`` artifacts: the capture
+pipeline either died silently (rc=124, empty tail) or emitted value-0.0
+error records whenever the shared pool stayed dark. The capture flow is now
+an explicit machine —
+
+    PROBE ──ok──▶ CAPTURE ──result──▶ EMIT
+      │  ▲            │
+   outage│  │window     │ outage-class attempt failure, no clock left
+      ▼  │opens       ▼
+    RIDE_OUTAGE ──budget gone──▶ FALLBACK ──▶ EMIT
+
+— and the budget-exhausted terminal state emits a *structured fallback*
+record (rc=0) that carries the last-good on-chip measurement, an optional
+fresh CPU-envelope measurement, and provenance flags, instead of rc=1 with
+``value: 0.0``. A pool outage can no longer produce an evidence-free round:
+the artifact says exactly what is known, and how it knows it.
+
+Stdlib-only: imported by the jax-free bench parent.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Any
+
+
+class CaptureState(enum.Enum):
+    PROBE = "PROBE"
+    CAPTURE = "CAPTURE"
+    RIDE_OUTAGE = "RIDE_OUTAGE"
+    FALLBACK = "FALLBACK"
+    EMIT = "EMIT"
+
+
+_LEGAL = {
+    CaptureState.PROBE: {
+        CaptureState.CAPTURE, CaptureState.RIDE_OUTAGE,
+        CaptureState.FALLBACK, CaptureState.EMIT,
+    },
+    CaptureState.RIDE_OUTAGE: {
+        # the window opening mid-ride goes straight to CAPTURE
+        CaptureState.PROBE, CaptureState.CAPTURE,
+        CaptureState.FALLBACK, CaptureState.EMIT,
+    },
+    CaptureState.CAPTURE: {CaptureState.FALLBACK, CaptureState.EMIT},
+    CaptureState.FALLBACK: {CaptureState.EMIT},
+    CaptureState.EMIT: set(),
+}
+
+
+class CaptureMachine:
+    """Tracks the capture flow; the transition log ships in the artifact.
+
+    The history is evidence: a FALLBACK record that shows
+    ``PROBE → RIDE_OUTAGE → FALLBACK → EMIT`` with timestamps and reasons
+    is auditable in a way "value: 0.0" never was.
+    """
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._t0 = clock()
+        self.state = CaptureState.PROBE
+        self.history: list[dict[str, Any]] = [
+            {"state": CaptureState.PROBE.value, "t": 0.0, "reason": "start"}
+        ]
+
+    def to(self, state: CaptureState, reason: str = "") -> None:
+        if state is self.state:
+            return  # re-entering a state (another outage probe) is a no-op
+        if state not in _LEGAL[self.state]:
+            raise ValueError(
+                f"illegal capture transition {self.state.value} -> "
+                f"{state.value}"
+            )
+        self.state = state
+        self.history.append({
+            "state": state.value,
+            "t": round(self._clock() - self._t0, 1),
+            "reason": reason[:300],
+        })
+
+    def path(self) -> list[str]:
+        return [h["state"] for h in self.history]
+
+
+def build_fallback_record(
+    *,
+    metric: str,
+    unit: str,
+    reason: str,
+    last_good: dict | None = None,
+    cpu_envelope: dict | None = None,
+    outage: dict | None = None,
+    capture_path: list[str] | None = None,
+) -> dict:
+    """The structured FALLBACK artifact.
+
+    The headline ``value`` is the last-good on-chip measurement when one
+    exists (clearly flagged ``measured: false`` — it is *context*, not a
+    fresh number), else 0.0. The CPU envelope rides alongside under its own
+    key: a CPU number must never impersonate the per-chip metric, but it
+    proves the code path still measures end-to-end while the pool is dark.
+    """
+    value = 0.0
+    vs_baseline = 0.0
+    if last_good and isinstance(last_good.get("value"), (int, float)):
+        value = float(last_good["value"])
+        vs_baseline = float(last_good.get("vs_baseline", 0.0))
+    return {
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": vs_baseline,
+        # provenance flags: every consumer (driver, harvester, reviewer)
+        # can tell this artifact from a fresh measurement at a glance
+        "provenance": "FALLBACK",
+        "measured": False,
+        "fallback": {
+            "reason": reason[:500],
+            "last_good": last_good,
+            "cpu_envelope": cpu_envelope,
+            "outage": outage or {},
+            "capture_path": capture_path or [],
+        },
+    }

@@ -128,12 +128,41 @@ def initialize(
         _INITIALIZED = True
         return
 
-    try:
+    from ..resilience.faults import fault_point
+    from ..resilience.outage import OutageClass, RetryPolicy, classify_exception
+
+    def _rendezvous():
+        # chaos site: a coordinator handshake failure surfaces here, before
+        # jax.distributed.initialize ever talks to the coordinator
+        fault_point("dist.rendezvous", process_id=process_id)
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
             local_device_ids=local_device_ids,
+        )
+
+    # transient coordinator failures (DEADLINE_EXCEEDED, connection refused
+    # while the coordinator is still binding) get one in-process backoff
+    # cycle before the rank dies and the launcher's elastic restart takes
+    # over; anything the shared classifier cannot call an outage propagates
+    # immediately
+    policy = RetryPolicy(
+        attempts=int(os.environ.get("GRAFT_RENDEZVOUS_ATTEMPTS", "2")),
+        base_delay_s=1.0,
+        max_delay_s=15.0,
+    )
+    try:
+        policy.run(
+            _rendezvous,
+            retry_on=lambda e: (
+                not isinstance(e, ValueError)
+                and classify_exception(e) is OutageClass.OUTAGE
+            ),
+            on_retry=lambda i, e, d: logger.warning(
+                "rendezvous attempt %d failed (%s); retrying in %.1fs",
+                i + 1, e, d,
+            ),
         )
     except ValueError:
         if not jax_native_rendezvous:
@@ -199,6 +228,11 @@ def coordination_barrier(name: str = "sync", timeout_s: float = 600.0) -> None:
     client = _jd.global_state.client
     if client is None:
         return
+    from ..resilience.faults import fault_point
+
+    # chaos site: a collective hang / UNAVAILABLE raise during a pool flap
+    # surfaces at the barrier — the first place a dead peer is observable
+    fault_point("collective.barrier", name=name)
     client.wait_at_barrier(name, timeout_in_ms=int(timeout_s * 1000))
 
 

@@ -27,6 +27,8 @@ import os
 import subprocess
 import sys
 
+from ..resilience.faults import active_plan
+from ..resilience.outage import OutageClass, RetryPolicy, classify
 from .dist import find_free_port
 
 
@@ -151,10 +153,34 @@ def _run_world(opt, attempt: int) -> int:
         )
     import time as _time
 
+    # monitor-driven chaos (site launch.worker): the launcher itself plays
+    # the preemption agent, SIGKILLing a chosen local rank after a delay.
+    # Hit counters reset per process, so cross-generation schedules key on
+    # the generation's attempt counter, matched here (not via env — the
+    # launcher's own GRAFT_RESTART_ATTEMPT is never set).
+    plan = active_plan()
+    chaos = []
+    if plan is not None:
+        chaos = [
+            r for r in plan.rules_for("launch.worker")
+            if r.attempt is None or r.attempt == attempt
+        ]
+    chaos_fired: set[int] = set()
+    all_procs = list(procs)  # stable local_rank -> proc indexing
+    t_start = _time.monotonic()
+
     code = 0
     failed_at = None
     try:
         while procs:
+            for i, rule in enumerate(chaos):
+                if i in chaos_fired:
+                    continue
+                if _time.monotonic() - t_start >= rule.after_s:
+                    chaos_fired.add(i)
+                    victim = all_procs[(rule.rank or 0) % len(all_procs)]
+                    if victim.poll() is None:
+                        victim.kill()
             for p in list(procs):
                 rc = p.poll()
                 if rc is None:
@@ -221,17 +247,41 @@ def main(argv=None) -> int:
             "elastic recovery needs an external coordinator"
         )
 
+    # one policy drives the inter-generation backoff; the shared classifier
+    # decides whether another generation can even help (a usage error or
+    # import typo fails identically every time — restarting burns the
+    # budget torchrun-style without the torchrun excuse)
+    policy = RetryPolicy(
+        attempts=opt.max_restarts + 1,
+        base_delay_s=float(os.environ.get("GRAFT_RESTART_BACKOFF", "0.5")),
+        max_delay_s=30.0,
+    )
+    delays = policy.delays()
     for attempt in range(opt.max_restarts + 1):
         code = _run_world(opt, attempt)
         if code == 0:
             return 0
+        cls = classify(code)
         if attempt < opt.max_restarts:
+            if cls is OutageClass.DETERMINISTIC:
+                print(
+                    f"[launch] world failed (rc={code}, class="
+                    f"{cls.value}): restarting cannot help, giving up",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                return code
+            delay = next(delays, 0.0)
             print(
-                f"[launch] world failed (rc={code}), restart "
-                f"{attempt + 1}/{opt.max_restarts}",
+                f"[launch] world failed (rc={code}, class={cls.value}), "
+                f"restart {attempt + 1}/{opt.max_restarts} "
+                f"in {delay:.1f}s",
                 file=sys.stderr,
                 flush=True,
             )
+            import time as _time
+
+            _time.sleep(delay)
     return code
 
 

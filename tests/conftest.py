@@ -25,7 +25,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no such option; the XLA_FLAGS mutation above (applied
+    # before backend init) provides the 8 virtual devices there
+    pass
 
 # Persistent compilation cache: repeated suite runs (and xdist workers after
 # the first run) skip XLA recompiles of identical programs — the single

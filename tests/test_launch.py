@@ -93,9 +93,12 @@ def test_launch_elastic_restart(tmp_path):
 
 def test_launch_elastic_exhausted(tmp_path):
     """A world that always fails exhausts its restart budget and reports
-    the child's exit code."""
+    the child's exit code. rc=1 is UNKNOWN-class (no outage signature,
+    but also no proof the failure is permanent), so the launcher keeps
+    restarting; a DETERMINISTIC rc would fail fast instead — see
+    test_resilience.py::test_launcher_gives_up_on_deterministic_failure."""
     script = tmp_path / "dead.py"
-    script.write_text("import sys; sys.exit(5)\n")
+    script.write_text("import sys; sys.exit(1)\n")
     proc = subprocess.run(
         [
             sys.executable, "-m",
@@ -105,8 +108,9 @@ def test_launch_elastic_exhausted(tmp_path):
         ],
         capture_output=True, text=True, timeout=120,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "GRAFT_RESTART_BACKOFF": "0.1"},
     )
-    assert proc.returncode == 5
+    assert proc.returncode == 1
     assert "restart 1/1" in proc.stderr
 
 
