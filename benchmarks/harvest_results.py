@@ -23,6 +23,8 @@ import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 STAGES = [
     ("bench", "headline SwinIR-S x2 train step (bench.py, committed knobs)"),
+    ("prefetch", "device-prefetch sync vs depth 1/2/3 (prefetch_bench.py)"),
+    ("bench_resident", "bench.py, GRAFT_BENCH_FEED=resident (no input pipe)"),
     # round-5 chain stage names (benchmarks/tpu_chain.sh r5)
     ("dispatch_probe", "tunnel dispatch-cost decomposition (dispatch_probe.py)"),
     ("bench_scan_k10", "bench.py, fused + lax.scan k=10 per dispatch"),
@@ -71,6 +73,7 @@ ARM_KNOBS = {
     ),
     "bench_fused_paired": "GRAFT_BENCH_OPT=fused GRAFT_BENCH_ATTN=paired",
     "bench_scan": "GRAFT_BENCH_OPT=fused GRAFT_BENCH_LOOP=scan",
+    "bench_resident": "GRAFT_BENCH_FEED=resident",
 }
 
 

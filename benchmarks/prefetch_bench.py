@@ -1,0 +1,116 @@
+"""Device-prefetch microbenchmark: synchronous vs depth-1/2/3 staging.
+
+Measures the overlap subsystem in isolation (no SwinIR, no optimizer): a
+compute-heavy jitted step consumes batches from the SAME loader fed four
+ways — synchronous ``place_on_mesh`` per batch, then ``device_iter`` at
+depth 1, 2 and 3. The spread between sync and depth>=2 is the H2D
+transfer time the prefetcher hides behind compute; depth 1 vs 2 shows
+whether one staged batch suffices or the transfer needs a deeper window.
+
+Prints one JSON line per arm: {"arm", "img_per_sec", "overlap_fraction",
+"depth"} plus a final {"summary": ...} line with the best arm. Runs on
+whatever backend is up (CPU included — transfers are cheap there, so CPU
+numbers only prove the plumbing; judge depths on a real chip).
+
+``GRAFT_PREFETCH_BENCH_STEPS`` / ``_BATCH`` / ``_DIM`` resize the run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import numpy as np
+
+STEPS = int(os.environ.get("GRAFT_PREFETCH_BENCH_STEPS", "40"))
+BATCH = int(os.environ.get("GRAFT_PREFETCH_BENCH_BATCH", "16"))
+DIM = int(os.environ.get("GRAFT_PREFETCH_BENCH_DIM", "512"))
+
+
+class _Samples:
+    """Distinct per-index samples so every batch is a real transfer."""
+
+    def __init__(self, n: int):
+        self.n = n
+        rng = np.random.default_rng(0)
+        self.pool = rng.random((8 * BATCH, DIM), dtype=np.float32)
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __getitem__(self, i: int):
+        return self.pool[i % len(self.pool)]
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributedtraining_tpu.data import DataLoader
+    from pytorch_distributedtraining_tpu.runtime.mesh import (
+        batch_spec, best_mesh,
+    )
+
+    mesh = best_mesh()
+    spec = batch_spec(mesh)
+    w = jnp.asarray(
+        np.random.default_rng(1).random((DIM, DIM), dtype=np.float32)
+    )
+
+    @jax.jit
+    def step(x, w):
+        # a few matmuls: enough compute per batch for a transfer to hide in
+        for _ in range(4):
+            x = jnp.tanh(x @ w)
+        return x.sum()
+
+    dl = DataLoader(
+        _Samples(STEPS * BATCH), batch_size=BATCH, shuffle=False,
+        drop_last=True, num_workers=2, mesh=mesh, spec=spec,
+    )
+
+    def run(arm: str, depth: int | None) -> dict:
+        # warm the compile outside the timed region
+        jax.block_until_ready(step(next(iter(dl)), w))
+        it = iter(dl) if depth is None else dl.device_iter(depth=depth)
+        t0 = time.perf_counter()
+        out = None
+        n = 0
+        for b in it:
+            out = step(b, w)
+            n += 1
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        frac = None if depth is None else it.overlap_fraction(dt)
+        row = {
+            "arm": arm,
+            "depth": depth,
+            "img_per_sec": round(BATCH * n / dt, 1),
+            "overlap_fraction": None if frac is None else round(frac, 4),
+            "steps": n,
+        }
+        print(json.dumps(row), flush=True)
+        return row
+
+    rows = [run("sync", None)]
+    for depth in (1, 2, 3):
+        rows.append(run(f"prefetch{depth}", depth))
+    best = max(rows, key=lambda r: r["img_per_sec"])
+    print(json.dumps({
+        "summary": "prefetch_bench",
+        "best_arm": best["arm"],
+        "best_img_per_sec": best["img_per_sec"],
+        "sync_img_per_sec": rows[0]["img_per_sec"],
+        "speedup_vs_sync": round(
+            best["img_per_sec"] / max(rows[0]["img_per_sec"], 1e-9), 3
+        ),
+        "batch": BATCH,
+        "platform": jax.devices()[0].platform,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -275,6 +275,9 @@ def main(argv=None):
         multiprocessing_context="spawn",
         # one spawn per run, not per epoch: worker startup is ~1 s each
         persistent_workers=True,
+        # stage 2 sharded batches onto the mesh ahead of the running step
+        # (H2D overlaps compute; $GRAFT_DEVICE_PREFETCH overrides)
+        device_prefetch=None,
     )
     val_dataloader = stoke_model.DataLoader(
         dataset=val_dataset,
@@ -286,6 +289,7 @@ def main(argv=None):
         num_workers=min(8, opt.threads),
         persistent_workers=True,
         drop_last=False,  # a small val split must not become zero batches
+        device_prefetch=None,
     )
 
     scheduler1 = OneCycleLR(

@@ -14,6 +14,7 @@ from .dataset import (
 )
 from .sampler import DistributedSampler
 from .loader import DataLoader, stack_windows
+from .prefetch import DevicePrefetcher, place_on_mesh
 from .transforms import PairedRandomAug
 
 __all__ = [
@@ -25,6 +26,8 @@ __all__ = [
     "random_split",
     "DistributedSampler",
     "DataLoader",
+    "DevicePrefetcher",
+    "place_on_mesh",
     "stack_windows",
     "PairedRandomAug",
 ]

@@ -150,11 +150,14 @@ class DataLoader:
         persistent_workers: bool = False,
         multiprocessing_context=None,  # None/"thread" -> threads
         auto_set_epoch: bool = True,
+        device_prefetch: int = 0,
     ):
         if sampler is not None and shuffle:
             raise ValueError("provide either sampler or shuffle, not both")
         if (mesh is None) != (spec is None):
             raise ValueError("mesh and spec must be given together")
+        if device_prefetch and mesh is None:
+            raise ValueError("device_prefetch requires mesh and spec")
         ctx = multiprocessing_context
         if ctx is not None and not isinstance(ctx, str):
             # torch also accepts a context object; keep its start method
@@ -183,6 +186,7 @@ class DataLoader:
         self.seed = seed
         self.mesh = mesh
         self.spec = spec
+        self.device_prefetch = max(0, int(device_prefetch))
         self.auto_set_epoch = auto_set_epoch
         self._epoch = 0
         self._explicit_epoch = False  # set_epoch() ever called by the user
@@ -269,35 +273,15 @@ class DataLoader:
     def _to_device(self, batch):
         if self.mesh is None:
             return batch
-        import jax
-        from jax.sharding import NamedSharding
+        from .prefetch import place_on_mesh
 
-        # a ragged tail (drop_last=False) cannot shard across the data axes —
-        # pad by repeating the last sample up to the divisibility requirement
-        # (metrics over a padded tail are marginally biased; a crash is worse).
-        # Only the batch dim (spec[0]) can be padded; other dims are fixed by
-        # the model and must already divide their mesh axes.
-        div = 1
-        batch_ax = self.spec[0] if self.spec else None
-        if batch_ax is not None:
-            names = (
-                batch_ax if isinstance(batch_ax, (tuple, list)) else (batch_ax,)
-            )
-            for n in names:
-                div *= self.mesh.shape.get(n, 1)
+        # ragged-tail padding + per-process global placement live in
+        # prefetch.place_on_mesh — one implementation shared by this
+        # synchronous path and the staged device_iter path
+        return place_on_mesh(batch, self.mesh, self.spec)
 
-        def place(a):
-            a = np.asarray(a)
-            if div > 1 and a.shape[0] % div:
-                pad = div - (a.shape[0] % div)
-                a = np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
-            return jax.make_array_from_process_local_data(
-                NamedSharding(self.mesh, self.spec), a
-            )
-
-        return jax.tree.map(place, batch)
-
-    def __iter__(self):
+    def _begin_epoch(self) -> list:
+        """Shared iteration prologue: epoch sync + index-order snapshot."""
         # the transform must see THIS epoch before the auto bump below
         # (fetches run lazily, after the bump has already moved _epoch)
         self._sync_transform_epoch()
@@ -315,7 +299,42 @@ class DataLoader:
             self._epoch += 1
             if self.sampler is not None:
                 self.sampler.set_epoch(self._epoch)
-        return self._make_iter(batches)
+        return batches
+
+    def __iter__(self):
+        if self.device_prefetch > 0:
+            return self.device_iter(depth=self.device_prefetch)
+        return self._make_iter(self._begin_epoch())
+
+    def device_iter(self, mesh=None, spec=None, depth: int = 2, probe=None):
+        """Iterate device-staged batches: a :class:`~.prefetch
+        .DevicePrefetcher` keeps up to ``depth`` sharded global batches
+        placed on the mesh ahead of the consumer, so the H2D transfer
+        overlaps the running step instead of serializing with it.
+
+        ``mesh``/``spec`` default to the loader's own; ``probe`` is an
+        optional ``TransferOverlapProbe`` receiving wait samples. On a
+        ``loader.stage`` fault (or a real staging failure) the iterator
+        degrades to synchronous feeding — no hang, no dropped batch.
+        """
+        from .prefetch import DevicePrefetcher
+
+        mesh = self.mesh if mesh is None else mesh
+        spec = self.spec if spec is None else spec
+        if mesh is None or spec is None:
+            raise ValueError(
+                "device_iter needs mesh and spec (constructor or call)"
+            )
+        pf = DevicePrefetcher(
+            self._make_iter(self._begin_epoch(), to_device=False),
+            mesh, spec, depth=depth, probe=probe,
+        )
+        # the prefetcher's feeder pulls fetches ahead of the consumer, so
+        # it is an epoch-race hazard exactly like a pooled feeder — even
+        # on the num_workers=0 path, which is otherwise fully lazy
+        self._feeders = [th for th in self._feeders if self._feeder_live(th)]
+        self._feeders.append(pf._thread)
+        return pf
 
     def _maybe_warn_iter_count_hazard(self):
         """One-shot warning for the auto_set_epoch desync hazard.
@@ -398,10 +417,13 @@ class DataLoader:
         except Exception:
             pass
 
-    def _make_iter(self, batches):
+    def _make_iter(self, batches, to_device: bool = True):
+        # to_device=False yields host batches for the DevicePrefetcher,
+        # which stages them asynchronously instead
         if self.num_workers <= 0:
             for idxs in batches:
-                yield self._to_device(self.collate_fn([self.dataset[i] for i in idxs]))
+                item = self.collate_fn([self.dataset[i] for i in idxs])
+                yield self._to_device(item) if to_device else item
             return
 
         # pooled fetch: workers load samples, a feeder thread keeps
@@ -462,7 +484,7 @@ class DataLoader:
                     return
                 if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
                     raise item[1]
-                yield self._to_device(item)
+                yield self._to_device(item) if to_device else item
         finally:
             stop.set()
             if not keep_pool:

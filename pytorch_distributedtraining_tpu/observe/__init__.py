@@ -9,13 +9,17 @@ per-step timing.
 from . import wandb_compat as wandb
 from .hlo import (
     CollectiveOp,
+    OverlapAudit,
+    OverlapFinding,
     collective_inventory,
+    collectives_schedulable,
     counts,
     has_logical_reduce_scatter,
     max_all_reduce_elems,
+    overlap_audit,
 )
 from .sink import JSONLSink, MetricsSink, NullSink, WandbSink, make_sink
-from .profiling import StepTimer, trace
+from .profiling import StepTimer, TransferOverlapProbe, trace
 
 __all__ = [
     "wandb",
@@ -25,10 +29,15 @@ __all__ = [
     "WandbSink",
     "make_sink",
     "StepTimer",
+    "TransferOverlapProbe",
     "trace",
     "CollectiveOp",
     "collective_inventory",
     "counts",
     "has_logical_reduce_scatter",
     "max_all_reduce_elems",
+    "OverlapAudit",
+    "OverlapFinding",
+    "overlap_audit",
+    "collectives_schedulable",
 ]

@@ -204,3 +204,99 @@ def counts(hlo_text: str) -> dict[str, int]:
     for op in collective_inventory(hlo_text):
         agg[op.kind] = agg.get(op.kind, 0) + 1
     return agg
+
+
+# -- compute/communication overlap audit -------------------------------------
+
+
+@dataclass(frozen=True)
+class OverlapFinding:
+    """One collective's overlap posture in a compiled module.
+
+    ``async_form``: the compiler split it into ``-start``/``-done`` pairs
+    (the precondition for the latency-hiding scheduler to move compute in
+    between). ``hidden_ops``: instructions actually scheduled between the
+    start and its done — 0 means the pair is back-to-back and the
+    collective still sits on the critical path despite being async.
+    """
+
+    kind: str
+    name: str
+    async_form: bool
+    hidden_ops: int
+    line: str
+
+    @property
+    def schedulable(self) -> bool:
+        return self.async_form and self.hidden_ops > 0
+
+    def __repr__(self) -> str:  # keep pytest output readable
+        form = "async" if self.async_form else "sync"
+        return f"OverlapFinding({self.kind}, {form}, hidden={self.hidden_ops})"
+
+
+@dataclass(frozen=True)
+class OverlapAudit:
+    """Module-level verdict over every collective's OverlapFinding."""
+
+    findings: tuple
+
+    @property
+    def total(self) -> int:
+        return len(self.findings)
+
+    @property
+    def blocking(self) -> tuple:
+        """Collectives stuck on the critical path (sync, or empty pairs)."""
+        return tuple(f for f in self.findings if not f.schedulable)
+
+    @property
+    def ok(self) -> bool:
+        """True when every collective can be hidden behind compute."""
+        return not self.blocking
+
+
+def overlap_audit(hlo_text: str) -> OverlapAudit:
+    """Audit whether a module's collectives are schedulable off the
+    critical path.
+
+    A collective printed in its synchronous form (``all-reduce(`` rather
+    than ``all-reduce-start(``) blocks: XLA executes it inline, so the DDP
+    grad reduction serializes with backward compute. An async pair only
+    helps if the scheduler actually placed work between ``-start`` and
+    ``-done`` — this counts the instructions in that window (parameters
+    excluded) per pair. Works on ``compiled.as_text()`` output.
+    """
+    lines = hlo_text.splitlines()
+    findings = []
+    for i, line in enumerate(lines):
+        m = _OP_RE.search(line)
+        if m is None:
+            continue
+        kind = m.group(1)
+        d = _DEF_RE.match(line)
+        name = d.group(1) if d else ""
+        if f"{kind}-start(" not in line:
+            findings.append(
+                OverlapFinding(kind, name, False, 0, line.strip())
+            )
+            continue
+        done_token = f"{kind}-done("
+        hidden = 0
+        for j in range(i + 1, len(lines)):
+            nxt = lines[j]
+            if done_token in nxt and _first_operand(nxt, done_token) == name:
+                break
+            dj = _DEF_RE.match(nxt)
+            if dj is not None and " parameter(" not in nxt:
+                hidden += 1
+        findings.append(OverlapFinding(kind, name, True, hidden, line.strip()))
+    return OverlapAudit(tuple(findings))
+
+
+def collectives_schedulable(hlo_text: str) -> bool:
+    """True when every collective in the module can overlap with compute.
+
+    Vacuously True for a module with no collectives (single-device step).
+    """
+    return overlap_audit(hlo_text).ok

@@ -5,6 +5,9 @@
   TensorBoard-loadable trace (XLA op-level, HBM, ICI traffic on TPU).
 - :class:`StepTimer`: cheap wall-clock per-step stats with warmup handling
   (first steps include compilation).
+- :class:`TransferOverlapProbe`: host-side transfer-vs-compute overlap
+  fraction — how much of the wall clock the consumer spent blocked waiting
+  for staged input versus running the step.
 """
 
 from __future__ import annotations
@@ -59,3 +62,60 @@ class StepTimer:
     def throughput(self, items_per_step: int) -> float:
         m = self.summary()
         return items_per_step / m["mean_s"] if m else 0.0
+
+
+@dataclass
+class TransferOverlapProbe:
+    """Measure how well input staging overlaps with compute.
+
+    The consumer marks time spent blocked on the input pipeline
+    (``waiting()`` / ``note_wait``) and time spent in the step itself
+    (``computing()`` / ``note_busy``). ``fraction()`` is the share of
+    accounted wall clock NOT lost to input waits — 1.0 means transfers were
+    fully hidden behind compute, 0.0 means the step was input-bound.
+
+    ``DevicePrefetcher`` accepts one as its ``probe`` and feeds
+    ``note_wait`` from its queue-get stalls, so a hot loop only needs to
+    wrap the step call in ``computing()``.
+    """
+
+    wait_s: float = 0.0
+    busy_s: float = 0.0
+    waits: int = 0
+
+    def note_wait(self, dt: float) -> None:
+        self.wait_s += max(0.0, dt)
+        self.waits += 1
+
+    def note_busy(self, dt: float) -> None:
+        self.busy_s += max(0.0, dt)
+
+    @contextlib.contextmanager
+    def waiting(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.note_wait(time.perf_counter() - t0)
+
+    @contextlib.contextmanager
+    def computing(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.note_busy(time.perf_counter() - t0)
+
+    def fraction(self) -> float | None:
+        total = self.wait_s + self.busy_s
+        if total <= 0.0:
+            return None
+        return max(0.0, min(1.0, 1.0 - self.wait_s / total))
+
+    def summary(self) -> dict:
+        return {
+            "wait_s": self.wait_s,
+            "busy_s": self.busy_s,
+            "waits": self.waits,
+            "overlap_fraction": self.fraction(),
+        }

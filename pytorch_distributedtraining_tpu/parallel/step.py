@@ -29,7 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..optim import FusedAdamW, refresh_params_ema
 from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
-from ..runtime.mesh import batch_spec
+from ..runtime.mesh import batch_spec, stacked_batch_spec
 from .policy import Policy
 from .spec import constrain, stream_to_device
 from .state import TrainState
@@ -430,9 +430,7 @@ class MultiStep:
         mesh = step.mesh
         # stacked batches add a leading scan axis: shard everything after it
         # exactly like the single-step batch
-        stacked_sharding = NamedSharding(
-            mesh, PartitionSpec(None, *batch_spec(mesh))
-        )
+        stacked_sharding = NamedSharding(mesh, stacked_batch_spec(mesh))
         sh = step._state_shardings
 
         def multi(state, batches, lr_factor):
@@ -462,6 +460,23 @@ class MultiStep:
             )
         with self.step.mesh:
             return self._jitted(state, batches, jnp.float32(lr_factor))
+
+    def feed(self, loader, depth: int | None = None):
+        """Stacked windows from a loader, staged ahead via device prefetch.
+
+        ``DataLoader.device_iter`` keeps up to ``depth`` batches in flight
+        on the mesh while the previous window computes; ``stack_windows``
+        then assembles ``[k, B, ...]`` stacks (already-on-device leaves
+        stack for free). Default depth is ``k`` — one whole window staged
+        ahead of the running one.
+        """
+        from ..data.loader import stack_windows
+
+        mesh = self.step.mesh
+        it = loader.device_iter(
+            mesh, batch_spec(mesh), depth=self.k if depth is None else depth
+        )
+        return stack_windows(it, self.k)
 
 
 def tune_multi_step_k(

@@ -18,6 +18,9 @@ Named sites (each threaded into the layer that owns it):
                        ``UNAVAILABLE`` error (``runtime/dist.py``)
 ``loader.fetch``       a data-loader worker crashes fetching a sample
                        (``data/loader.py``, thread and process paths)
+``loader.stage``       H2D staging of a prefetched batch fails; the
+                       prefetcher degrades to synchronous feeding
+                       (``data/prefetch.py``)
 ``checkpoint.write``   transient I/O error on a checkpoint write
                        (``checkpoint_sharded.py``)
 ``train.preempt``      mid-step SIGTERM preemption, delivered to self at a
@@ -74,6 +77,7 @@ SITES = frozenset({
     "dist.rendezvous",
     "collective.barrier",
     "loader.fetch",
+    "loader.stage",
     "checkpoint.write",
     "train.preempt",
     "bench.probe",

@@ -19,11 +19,13 @@ from .dist import (
     find_free_port,
     force_platform,
     force_platform_from_env,
+    enable_latency_hiding_scheduler,
 )
 from .mesh import (
     MeshSpec, make_mesh, make_hybrid_mesh, best_mesh, mesh_axis_size,
     current_mesh,
 )
+from .cache import cache_dir, enable_compile_cache, cache_entry_count
 
 __all__ = [
     "initialize",
@@ -38,6 +40,10 @@ __all__ = [
     "find_free_port",
     "force_platform",
     "force_platform_from_env",
+    "enable_latency_hiding_scheduler",
+    "cache_dir",
+    "enable_compile_cache",
+    "cache_entry_count",
     "MeshSpec",
     "make_mesh",
     "make_hybrid_mesh",

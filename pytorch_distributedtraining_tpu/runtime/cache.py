@@ -18,7 +18,12 @@ import os
 
 from .._hostfp import machine_fingerprint
 
-__all__ = ["cache_dir", "machine_fingerprint"]
+ENV_VAR = "GRAFT_COMPILE_CACHE"
+
+__all__ = [
+    "cache_dir", "machine_fingerprint", "enable_compile_cache",
+    "cache_entry_count", "ENV_VAR",
+]
 
 
 def cache_dir(label: str) -> str:
@@ -32,3 +37,44 @@ def cache_dir(label: str) -> str:
     if env:
         return env
     return f"/tmp/jax_{label}_cache_{os.getuid()}_{machine_fingerprint()}"
+
+
+def enable_compile_cache(
+    label: str = "graft", env_var: str = ENV_VAR
+) -> str | None:
+    """Turn on jax's persistent compilation cache; return its path.
+
+    Honors ``$GRAFT_COMPILE_CACHE``: ``0``/``off``/``false`` disables and
+    returns None; empty or ``1`` uses the machine-keyed default from
+    :func:`cache_dir`; any other value is taken as the cache directory
+    itself. Lowers the persistent-cache min-compile-time threshold so even
+    small test programs land in the cache (the 1s default would skip most
+    of a CPU smoke run).
+    """
+    raw = os.environ.get(env_var, "").strip()
+    if raw.lower() in ("0", "off", "false"):
+        return None
+    path = cache_dir(label) if raw in ("", "1") else raw
+    os.makedirs(path, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    try:  # knob moved/renamed across jax versions; the dir alone suffices
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    except Exception:
+        pass
+    return path
+
+
+def cache_entry_count(path: str | None) -> int:
+    """Number of files under a compile-cache dir (0 for None/missing).
+
+    Counting before and after a compile distinguishes a cache hit (count
+    unchanged) from a miss (new entries) — jax has no public hit counter.
+    """
+    if not path:
+        return 0
+    try:
+        return sum(len(files) for _, _, files in os.walk(path))
+    except OSError:
+        return 0

@@ -201,6 +201,17 @@ def batch_spec(mesh: Mesh) -> P:
     return P(data_axes(mesh))
 
 
+def stacked_batch_spec(mesh: Mesh) -> P:
+    """PartitionSpec for a ``[k, batch, ...]`` stacked-window array.
+
+    The scan axis is replicated (every device runs all k microbatch
+    steps); everything after it shards like the single-step batch. This
+    is the layout ``MultiStep`` expects and ``stack_windows`` over a
+    ``DataLoader.device_iter`` produces.
+    """
+    return P(None, *batch_spec(mesh))
+
+
 def divisors_check(n: int, by: int, what: str) -> None:
     if n % by:
         raise ValueError(f"{what}={n} not divisible by mesh axis size {by}")

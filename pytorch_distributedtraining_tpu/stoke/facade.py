@@ -23,6 +23,7 @@ Grad accumulation follows Stoke semantics: ``.backward`` scales by
 from __future__ import annotations
 
 import inspect
+import os
 import threading
 import time
 import weakref
@@ -1134,14 +1135,25 @@ class Stoke:
         sampler=None,
         num_workers: int = 0,
         drop_last: bool = True,
+        device_prefetch: int | None = None,
         **kwargs,
     ):
         """Loader bound to the facade's batch size and mesh
         (`Stoke-DDP.py:286-298`). Per-process batch =
         ``batch_size_per_device × local device count``; ``drop_last``
-        defaults True (static shapes — XLA recompiles on ragged tails)."""
+        defaults True (static shapes — XLA recompiles on ragged tails).
+
+        ``device_prefetch`` (default from ``$GRAFT_DEVICE_PREFETCH``, 2)
+        stages that many sharded batches onto the mesh ahead of the hot
+        loop so H2D transfers overlap the running step; 0 reverts to
+        synchronous per-batch placement.
+        """
         if batch_size is None:
             batch_size = self.batch_size_per_device * jax.local_device_count()
+        if device_prefetch is None:
+            device_prefetch = int(
+                os.environ.get("GRAFT_DEVICE_PREFETCH", "2") or 0
+            )
         # multiprocessing_context passes through: a spawn/fork context is a
         # real process pool in the loader (GIL escape hatch), not a no-op
         return _DataLoader(
@@ -1152,6 +1164,7 @@ class Stoke:
             drop_last=drop_last,
             mesh=self.mesh,
             spec=batch_spec(self.mesh),
+            device_prefetch=device_prefetch,
             **kwargs,
         )
 
