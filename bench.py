@@ -342,6 +342,7 @@ _ARM_ENVS = (  # envs that change WHICH arm is being measured
     "GRAFT_BENCH_OPT", "GRAFT_BENCH_ATTN", "GRAFT_BENCH_ATTN_PACK",
     "GRAFT_BENCH_NORM", "GRAFT_BENCH_SOFTMAX", "GRAFT_BENCH_LOOP",
     "GRAFT_BENCH_SCAN_K", "GRAFT_BENCH_FEED", "GRAFT_BENCH_PREFETCH",
+    "GRAFT_REMAT", "GRAFT_SCAN_LAYERS",
 )
 
 
@@ -793,13 +794,14 @@ def _bench() -> None:
             raise SystemExit(f"bench_knobs.json unreadable: {e}")
         unknown = set(knobs) - {
             "attn", "attn_pack", "norm", "softmax", "opt", "loop", "scan_k",
-            "feed",
+            "feed", "remat", "scan_layers",
         }
         if unknown:
             # a typoed key would otherwise silently no-op the default flip
             raise SystemExit(
                 f"bench_knobs.json unknown keys {sorted(unknown)}; valid: "
-                "attn, attn_pack, norm, softmax, opt, loop, scan_k, feed"
+                "attn, attn_pack, norm, softmax, opt, loop, scan_k, feed, "
+                "remat, scan_layers"
             )
 
     resolved = {}  # effective value + where it came from, for the log line
@@ -823,6 +825,20 @@ def _bench() -> None:
             f"attn_pack must be an int, got {pack_raw!r} "
             f"(from {resolved['attn_pack'][1]})"
         )
+    # remat policy + scan-over-layers (ISSUE 3). remat applies per Swin
+    # layer/pair inside the model (the fine-grained form — Policy.remat
+    # would blanket the whole loss fn); scan compiles one W-MSA/SW-MSA
+    # pair per RSTB instead of depth layers. Both resolve through the same
+    # env > json > default chain and are reported in the result JSON.
+    from pytorch_distributedtraining_tpu.parallel.remat import resolve_remat
+
+    remat_raw = knob("GRAFT_REMAT", "remat", "none")
+    try:
+        remat_impl = resolve_remat(remat_raw)
+    except ValueError as e:
+        raise SystemExit(f"remat: {e} (from {resolved['remat'][1]})")
+    scan_layers_raw = knob("GRAFT_SCAN_LAYERS", "scan_layers", "0")
+    scan_layers = scan_layers_raw.strip().lower() in ("1", "true", "on", "yes")
     model = SwinIR(
         dtype=jnp.bfloat16,  # reference config, bf16 MXU path
         attn_impl=knob("GRAFT_BENCH_ATTN", "attn", "xla"),
@@ -837,6 +853,8 @@ def _bench() -> None:
             if knob("GRAFT_BENCH_SOFTMAX", "softmax", "f32") == "bf16"
             else jnp.float32
         ),
+        remat=remat_impl,
+        scan_layers=scan_layers,
     )
     # Stoke-DDP.py:253,164; "fused" = flat FusedAdamW (same numerics, one
     # ravelled vector update — kills the per-leaf op tail the profiler
@@ -1174,6 +1192,25 @@ def _bench() -> None:
         best = rates.index(img_per_sec)
         f = overlap_fracs[best]
         overlap_fraction = None if f is None else round(f, 4)
+    # HBM accounting (untimed, after the windows): XLA's memory plan for
+    # the compiled step — the persistent compile cache makes this AOT
+    # lower+compile a cheap deserialize, not a second cold compile. None
+    # when the backend has no memory analysis.
+    peak_hbm_bytes = None
+    try:
+        mem = step.memory_analysis(state, batch)
+        if mem is not None:
+            peak_hbm_bytes = mem.peak_bytes
+            print(
+                f"# child: projected peak HBM {peak_hbm_bytes / 1e6:.1f} MB "
+                f"(args {mem.argument_bytes / 1e6:.1f} + out "
+                f"{mem.output_bytes / 1e6:.1f} + temp "
+                f"{mem.temp_bytes / 1e6:.1f} - alias "
+                f"{mem.alias_bytes / 1e6:.1f})",
+                flush=True,
+            )
+    except Exception as e:  # noqa: BLE001 — accounting must not kill a run
+        print(f"# child: memory analysis unavailable: {e}", flush=True)
     cache_entries_now = cache_entry_count(cache_path)
     compile_cache = {
         "enabled": cache_path is not None,
@@ -1206,6 +1243,9 @@ def _bench() -> None:
                 ),
                 "overlap_fraction": overlap_fraction,
                 "compile_cache": compile_cache,
+                "peak_hbm_bytes": peak_hbm_bytes,
+                "remat": remat_impl,
+                "scan_layers": scan_layers,
             }
         )
     )

@@ -23,6 +23,9 @@ import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 STAGES = [
     ("bench", "headline SwinIR-S x2 train step (bench.py, committed knobs)"),
+    ("compile", "cold vs cached vs scanned compile time (compile_bench.py)"),
+    ("bench_remat", "bench.py, GRAFT_REMAT=full (activation remat arm)"),
+    ("bench_scan_layers", "bench.py, GRAFT_SCAN_LAYERS=1 (scanned RSTBs)"),
     ("prefetch", "device-prefetch sync vs depth 1/2/3 (prefetch_bench.py)"),
     ("bench_resident", "bench.py, GRAFT_BENCH_FEED=resident (no input pipe)"),
     # round-5 chain stage names (benchmarks/tpu_chain.sh r5)
@@ -74,6 +77,8 @@ ARM_KNOBS = {
     "bench_fused_paired": "GRAFT_BENCH_OPT=fused GRAFT_BENCH_ATTN=paired",
     "bench_scan": "GRAFT_BENCH_OPT=fused GRAFT_BENCH_LOOP=scan",
     "bench_resident": "GRAFT_BENCH_FEED=resident",
+    "bench_remat": "GRAFT_REMAT=full",
+    "bench_scan_layers": "GRAFT_SCAN_LAYERS=1",
 }
 
 

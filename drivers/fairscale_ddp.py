@@ -120,13 +120,19 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
         inputs, targets = batch
         return mse_loss(model.apply({"params": params}, inputs), targets), {}
 
-    # OSS(AdamW) + ShardedDDP wrap (:78-89) -> ZeRO2 policy on the engine
+    # OSS(AdamW) + ShardedDDP wrap (:78-89) -> ZeRO2 policy on the engine;
+    # --remat/$GRAFT_REMAT picks the activation-checkpoint policy
+    remat = getattr(opt, "remat", None)
+    if remat is None:
+        remat = os.environ.get("GRAFT_REMAT", "none")
     tx = optim.adamw(lr=1e-3, betas=(0.9, 0.99), eps=1e-8, weight_decay=1e-4)
     state, shardings = create_train_state(
         model=model, sample_input=jnp.asarray(np.asarray(x)[:1]),
-        tx=tx, mesh=mesh, policy=ZeRO2(),
+        tx=tx, mesh=mesh, policy=ZeRO2(remat=remat),
     )
-    step = TrainStep(loss_fn, tx, mesh, ZeRO2(), state_shardings=shardings)
+    step = TrainStep(
+        loss_fn, tx, mesh, ZeRO2(remat=remat), state_shardings=shardings
+    )
 
     loss = None
     for e in range(epochs):
@@ -154,6 +160,10 @@ def main(argv=None):
     parser.add_argument("--synthetic", action="store_true",
                         help="train on synthetic SR data (no dataset needed)")
     parser.add_argument("--synthetic-n", type=int, default=512)
+    parser.add_argument("--remat", type=str, default=None,
+                        help="activation remat policy for the step: "
+                             "none/full/dots/names/offload "
+                             "(default: $GRAFT_REMAT or none)")
     opt = parser.parse_args(argv)
 
     # GRAFT_PLATFORM=cpu forces the backend (see runtime.dist docstring:

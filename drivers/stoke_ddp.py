@@ -177,6 +177,15 @@ def build_parser():
                         help="checkpoint to load (nested 'params' key supported)")
     parser.add_argument("--fp16", type=str, default=None, choices=[None, "amp", "bf16"],
                         help="precision: amp (fp16+scaler) or bf16")
+    parser.add_argument("--scan-layers", action="store_true",
+                        default=os.environ.get("GRAFT_SCAN_LAYERS", "").strip().lower()
+                        in ("1", "true", "on", "yes"),
+                        help="nn.scan the RSTB layer stacks (one compiled "
+                             "W-MSA/SW-MSA pair per RSTB; cold-compile lever)")
+    parser.add_argument("--remat", type=str, default=None,
+                        help="activation remat policy per Swin layer/pair: "
+                             "none/full/dots/names/offload "
+                             "(default: $GRAFT_REMAT or none)")
     return parser
 
 
@@ -204,12 +213,24 @@ def main(argv=None):
     oss_config = FairscaleOSSConfig(broadcast_fp16=True)
 
     print("===> Building model")
+    # --remat/--scan-layers thread the ISSUE-3 knobs ($GRAFT_REMAT /
+    # $GRAFT_SCAN_LAYERS are the env twins; the facade also applies the
+    # env fallbacks, so the explicit flags here just make them CLI-visible)
+    from pytorch_distributedtraining_tpu.parallel.remat import resolve_remat
+
+    remat = resolve_remat(
+        opt.remat if opt.remat is not None
+        else os.environ.get("GRAFT_REMAT", "none")
+    )
     model = SwinIR(
         upscale=2, in_chans=3, img_size=64, window_size=8,
         img_range=1.0, depths=[6, 6, 6, 6], embed_dim=60,
         num_heads=[6, 6, 6, 6], mlp_ratio=2,
         upsampler="pixelshuffledirect", resi_connection="1conv",
+        remat=remat, scan_layers=opt.scan_layers,
     )
+    if opt.scan_layers or remat != "none":
+        print(f"===> scan_layers={opt.scan_layers} remat={remat}")
 
     loss = feat_loss
 

@@ -19,6 +19,11 @@ _LAZY = {
     "Net": ".sr_espcn",
     "pixel_shuffle": ".sr_espcn",
     "SwinIR": ".swinir",
+    "stack_swinir_layer_params": ".swinir",
+    "unstack_swinir_layer_params": ".swinir",
+    "stack_layer_params": ".scan_utils",
+    "unstack_layer_params": ".scan_utils",
+    "remat_block": ".scan_utils",
     "ResNet": ".resnet",
     "ResNet18": ".resnet",
     "ResNet34": ".resnet",

@@ -24,6 +24,9 @@ from typing import Callable, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+
+from .scan_utils import remat_block
 
 AttnFn = Callable[..., jnp.ndarray]  # (q, k, v, *, causal) -> out
 
@@ -76,7 +79,16 @@ class GPT2Config:
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
     tie_word_embeddings: bool = True
-    remat: bool = False  # checkpoint each block (FSDP memory, SURVEY §7c)
+    # Checkpoint each block (FSDP memory, SURVEY §7c): bool (True == "full")
+    # or a named policy from parallel/remat.py ("dots"/"names"/"offload").
+    remat: bool | str = False
+    # Run the block stack under `nn.scan` (jax.lax.scan over stacked
+    # per-layer params): XLA traces/compiles ONE block instead of n_layer —
+    # the cold-compile lever. Param layout changes from `h_{i}/...` to a
+    # stacked `h/...` (leading axis n_layer); `scan_utils.stack_layer_params`
+    # converts loop-layout checkpoints. Ignored under `decode=True` (the KV
+    # cache keeps the unrolled loop).
+    scan_layers: bool = False
 
     @staticmethod
     def gpt2_125m() -> "GPT2Config":
@@ -126,6 +138,8 @@ class Block(nn.Module):
     cfg: GPT2Config
     attn_fn: AttnFn = default_attention
     decode: bool = False
+    # scan-body mode: return (x, None) so the block slots into nn.scan
+    as_scan_body: bool = False
 
     def _cached_attention(self, q, k, v, idx):
         """[B, T, H, Dh] step against the persistent cache; ``idx`` is the
@@ -170,6 +184,9 @@ class Block(nn.Module):
             )
         else:
             y = self.attn_fn(reshape(q), reshape(k), reshape(v), causal=True)
+        # named-remat tag (parallel/remat.py "names"/"offload" policies):
+        # save the softmax·V product, recompute the cheap projections
+        y = checkpoint_name(y, "attn_out")
         y = y.reshape(*y.shape[:2], d)
         y = dense(d, "c_proj")(y)
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
@@ -180,7 +197,10 @@ class Block(nn.Module):
         y = nn.gelu(y, approximate=True)
         y = dense(d, "mlp_proj")(y)
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
-        return x + y
+        out = x + y
+        if self.as_scan_body:
+            return out, None
+        return out
 
 
 class GPT2(nn.Module):
@@ -223,13 +243,28 @@ class GPT2(nn.Module):
         x = wte[tokens].astype(cfg.dtype) + pe.astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
-        block_cls = Block
-        if cfg.remat:
-            block_cls = nn.remat(Block, static_argnums=(2,))  # (self, x, det)
-        for i in range(cfg.n_layer):
-            x = block_cls(cfg, self.attn_fn, self.decode, name=f"h_{i}")(
-                x, deterministic, start_index
+        if cfg.scan_layers and not self.decode:
+            # one traced/compiled block for all n_layer (stacked params on
+            # a leading axis under name "h"); per-block remat nests inside
+            # the scan — the standard form: scan saves only the inter-layer
+            # carry, remat recomputes block internals in backward
+            block_cls = remat_block(Block, cfg.remat, in_scan=True)
+            blocks = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast, nn.broadcast),
+                length=cfg.n_layer,
             )
+            x, _ = blocks(
+                cfg, self.attn_fn, False, True, name="h"
+            )(x, deterministic, start_index)
+        else:
+            block_cls = remat_block(Block, cfg.remat)
+            for i in range(cfg.n_layer):
+                x = block_cls(cfg, self.attn_fn, self.decode, name=f"h_{i}")(
+                    x, deterministic, start_index
+                )
 
         x = nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name="ln_f")(x)
         if cfg.tie_word_embeddings:

@@ -31,8 +31,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
+from jax.ad_checkpoint import checkpoint_name
 
 from .sr_espcn import pixel_shuffle
+from .scan_utils import remat_block, stack_trees, unstack_tree
 
 
 def window_partition(x: jnp.ndarray, ws: int) -> jnp.ndarray:
@@ -209,6 +211,7 @@ class WindowAttention(nn.Module):
                 pwa.auto_interpret(),
             )  # [bn, h, n, d], softmax in f32 in-kernel
             out = out.transpose(0, 2, 1, 3).reshape(bn, n, c)
+            out = checkpoint_name(out, "attn_out")
             return nn.Dense(c, dtype=self.dtype, name="proj")(out)
 
         scale = head_dim**-0.5
@@ -226,6 +229,9 @@ class WindowAttention(nn.Module):
             attn.astype(self.softmax_dtype), axis=-1
         ).astype(self.dtype)
         out = (attn @ v).transpose(0, 2, 1, 3).reshape(bn, n, c)
+        # named-remat tag (parallel/remat.py "names"/"offload"): save the
+        # softmax·V product, recompute the cheap projections
+        out = checkpoint_name(out, "attn_out")
         return nn.Dense(c, dtype=self.dtype, name="proj")(out)
 
     def _paired(self, qkv, bias, mask, p: int):
@@ -275,6 +281,7 @@ class WindowAttention(nn.Module):
         out = out.reshape(bn // p, h, p, n, d).transpose(
             0, 2, 3, 1, 4
         ).reshape(bn, n, c)
+        out = checkpoint_name(out, "attn_out")
         return nn.Dense(c, dtype=self.dtype, name="proj")(out)
 
     def _blockdiag(self, q, k, v, bias, mask):
@@ -310,6 +317,7 @@ class WindowAttention(nn.Module):
         )(v)  # [bn, h*n, h*d]
         p2 = attn.transpose(0, 2, 1, 3).reshape(bn, n, h * n)
         out = p2 @ vblk  # heads already concatenated
+        out = checkpoint_name(out, "attn_out")
         return nn.Dense(c, dtype=self.dtype, name="proj")(out)
 
 
@@ -358,6 +366,44 @@ class SwinLayer(nn.Module):
         return x + y.astype(x.dtype)
 
 
+class SwinLayerPair(nn.Module):
+    """W-MSA + SW-MSA pair — the ``nn.scan`` body for RSTB's layer stack.
+
+    Swin alternates shift=0 / shift=ws//2, so the smallest repeating unit
+    is a PAIR of layers, not one layer (the two have different static
+    masks). Scan-layout params live under ``layers/a`` (unshifted) and
+    ``layers/b`` (shifted), each with a leading ``depth//2`` axis —
+    ``stack_swinir_layer_params`` converts loop-layout checkpoints.
+    """
+
+    dim: int
+    num_heads: int
+    window_size: int
+    mlp_ratio: float
+    dtype: jnp.dtype = jnp.float32
+    norm_dtype: jnp.dtype = jnp.float32
+    softmax_dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"
+    attn_pack: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        kw = dict(
+            mlp_ratio=self.mlp_ratio, dtype=self.dtype,
+            norm_dtype=self.norm_dtype, softmax_dtype=self.softmax_dtype,
+            attn_impl=self.attn_impl, attn_pack=self.attn_pack,
+        )
+        x = SwinLayer(
+            self.dim, self.num_heads, self.window_size, shift=0,
+            name="a", **kw,
+        )(x)
+        x = SwinLayer(
+            self.dim, self.num_heads, self.window_size,
+            shift=self.window_size // 2, name="b", **kw,
+        )(x)
+        return x, None  # (carry, scan output)
+
+
 class RSTB(nn.Module):
     """Residual Swin Transformer Block: depth STLs + conv + residual."""
 
@@ -371,19 +417,47 @@ class RSTB(nn.Module):
     softmax_dtype: jnp.dtype = jnp.float32
     attn_impl: str = "xla"
     attn_pack: int = 1
+    # Activation remat per layer/pair: bool (True == "full") or a named
+    # policy from parallel/remat.py
+    remat: bool | str = False
+    # nn.scan over W-MSA/SW-MSA pairs: one compiled pair instead of depth
+    # layers. Needs even depth >= 2 (falls back to the loop otherwise).
+    scan_layers: bool = False
 
     @nn.compact
     def __call__(self, x):
         shortcut = x
-        for i in range(self.depth):
-            x = SwinLayer(
+        kw = dict(
+            mlp_ratio=self.mlp_ratio, dtype=self.dtype,
+            norm_dtype=self.norm_dtype, softmax_dtype=self.softmax_dtype,
+            attn_impl=self.attn_impl, attn_pack=self.attn_pack,
+        )
+        if self.scan_layers and self.depth >= 2 and self.depth % 2 == 0:
+            # one traced/compiled pair for all depth//2 iterations; remat
+            # nests inside the scan (standard form: scan saves only the
+            # inter-pair carry, remat recomputes pair internals backward).
+            # SwinLayer.__call__ is (self, x) — no static args.
+            pair_cls = remat_block(
+                SwinLayerPair, self.remat, static_argnums=(), in_scan=True
+            )
+            pairs = nn.scan(
+                pair_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=self.depth // 2,
+            )
+            x, _ = pairs(
                 self.dim, self.num_heads, self.window_size,
-                shift=0 if i % 2 == 0 else self.window_size // 2,
-                mlp_ratio=self.mlp_ratio, dtype=self.dtype,
-                norm_dtype=self.norm_dtype, softmax_dtype=self.softmax_dtype,
-                attn_impl=self.attn_impl, attn_pack=self.attn_pack,
-                name=f"layer_{i}",
+                name="layers", **kw,
             )(x)
+        else:
+            layer_cls = remat_block(SwinLayer, self.remat, static_argnums=())
+            for i in range(self.depth):
+                x = layer_cls(
+                    self.dim, self.num_heads, self.window_size,
+                    shift=0 if i % 2 == 0 else self.window_size // 2,
+                    name=f"layer_{i}", **kw,
+                )(x)
         # resi_connection='1conv' (Stoke-DDP.py:208)
         x = nn.Conv(self.dim, (3, 3), padding="SAME", dtype=self.dtype, name="conv")(x)
         return shortcut + x.astype(shortcut.dtype)
@@ -414,6 +488,15 @@ class SwinIR(nn.Module):
     # WindowAttention.attn_impl for what each computes
     attn_impl: str = "xla"
     attn_pack: int = 1  # pallas impl: windows fused per attention tile
+    # Activation remat per Swin layer/pair: bool (True == "full") or a
+    # named policy from parallel/remat.py ("dots"/"names"/"offload")
+    remat: bool | str = False
+    # nn.scan over each RSTB's W-MSA/SW-MSA pairs: XLA compiles ONE pair
+    # per RSTB instead of depth layers — the cold-compile lever. Param
+    # layout changes from `layer_{i}` to stacked `layers/{a,b}`;
+    # `stack_swinir_layer_params` converts loop-layout checkpoints (incl.
+    # torch imports). GRAFT_SCAN_LAYERS toggles this through the facade.
+    scan_layers: bool = False
 
     @nn.compact
     def __call__(self, x):  # [B, H, W, C] in [0, img_range]
@@ -450,7 +533,8 @@ class SwinIR(nn.Module):
                 self.embed_dim, depth, heads, ws, self.mlp_ratio,
                 dtype=self.dtype, norm_dtype=self.norm_dtype,
                 softmax_dtype=self.softmax_dtype, attn_impl=self.attn_impl,
-                attn_pack=self.attn_pack,
+                attn_pack=self.attn_pack, remat=self.remat,
+                scan_layers=self.scan_layers,
                 name=f"rstb_{i}",
             )(y)
         y = nn.LayerNorm(dtype=self.norm_dtype, name="norm")(y).astype(self.dtype)
@@ -539,3 +623,36 @@ class SwinIR(nn.Module):
         if pad_h or pad_w:
             out = out[:, : h * r, : w * r, :]
         return out
+
+
+def stack_swinir_layer_params(params: dict, depths: Sequence[int]) -> dict:
+    """Loop layout -> scan layout for every ``rstb_{i}`` subtree:
+    ``layer_{2j}`` stacks under ``layers/a`` (unshifted) and
+    ``layer_{2j+1}`` under ``layers/b`` (shifted), leading axis depth//2.
+    Use on loop-layout checkpoints (incl. torch imports through
+    ``interop.load_torch_into_template``) before binding to a
+    ``scan_layers=True`` model. Returns a new dict.
+    """
+    out = dict(params)
+    for i, depth in enumerate(depths):
+        rstb = dict(out[f"rstb_{i}"])
+        a = [rstb.pop(f"layer_{2 * j}") for j in range(depth // 2)]
+        b = [rstb.pop(f"layer_{2 * j + 1}") for j in range(depth // 2)]
+        rstb["layers"] = {"a": stack_trees(a), "b": stack_trees(b)}
+        out[f"rstb_{i}"] = rstb
+    return out
+
+
+def unstack_swinir_layer_params(params: dict, depths: Sequence[int]) -> dict:
+    """Scan layout -> loop layout (inverse of ``stack_swinir_layer_params``);
+    use before exporting a scanned model to a torch checkpoint."""
+    out = dict(params)
+    for i, depth in enumerate(depths):
+        rstb = dict(out[f"rstb_{i}"])
+        layers = rstb.pop("layers")
+        for j, tree in enumerate(unstack_tree(layers["a"], depth // 2)):
+            rstb[f"layer_{2 * j}"] = tree
+        for j, tree in enumerate(unstack_tree(layers["b"], depth // 2)):
+            rstb[f"layer_{2 * j + 1}"] = tree
+        out[f"rstb_{i}"] = rstb
+    return out

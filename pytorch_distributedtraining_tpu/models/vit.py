@@ -16,8 +16,10 @@ from typing import Callable
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from .gpt2 import default_attention
+from .scan_utils import remat_block
 
 
 @dataclass(frozen=True)
@@ -31,7 +33,11 @@ class ViTConfig:
     mlp_dim: int = 3072
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
-    remat: bool = False
+    # bool (True == "full") or a named policy from parallel/remat.py
+    remat: bool | str = False
+    # nn.scan over the encoder stack: one compiled block, params stacked
+    # under "encoder" (vs per-layer "encoder_{i}"); see models/scan_utils.py
+    scan_layers: bool = False
 
     @staticmethod
     def b16() -> "ViTConfig":
@@ -49,6 +55,8 @@ class ViTConfig:
 class EncoderBlock(nn.Module):
     cfg: ViTConfig
     attn_fn: Callable = default_attention
+    # scan-body mode: return (x, None) so the block slots into nn.scan
+    as_scan_body: bool = False
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
@@ -62,6 +70,9 @@ class EncoderBlock(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         reshape = lambda a: a.reshape(*a.shape[:2], h, d // h)  # noqa: E731
         y = self.attn_fn(reshape(q), reshape(k), reshape(v), causal=False)
+        # named-remat tag ("names"/"offload" policies): save softmax·V,
+        # recompute the cheap projections
+        y = checkpoint_name(y, "attn_out")
         y = y.reshape(*y.shape[:2], d)
         y = dense(d, name="c_proj")(y)
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
@@ -72,7 +83,10 @@ class EncoderBlock(nn.Module):
         y = nn.gelu(y)
         y = dense(d, name="mlp_proj")(y)
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
-        return x + y
+        out = x + y
+        if self.as_scan_body:
+            return out, None
+        return out
 
 
 class ViT(nn.Module):
@@ -100,13 +114,26 @@ class ViT(nn.Module):
         x = x + pos.astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
-        block_cls = EncoderBlock
-        if cfg.remat:
-            block_cls = nn.remat(EncoderBlock, static_argnums=(2,))  # (self, x, det)
-        for i in range(cfg.num_layers):
-            x = block_cls(cfg, self.attn_fn, name=f"encoder_{i}")(
+        if cfg.scan_layers:
+            # one traced/compiled block for all num_layers (stacked params
+            # under "encoder"); remat nests inside the scan
+            block_cls = remat_block(EncoderBlock, cfg.remat, in_scan=True)
+            blocks = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast,),
+                length=cfg.num_layers,
+            )
+            x, _ = blocks(cfg, self.attn_fn, True, name="encoder")(
                 x, deterministic
             )
+        else:
+            block_cls = remat_block(EncoderBlock, cfg.remat)
+            for i in range(cfg.num_layers):
+                x = block_cls(cfg, self.attn_fn, name=f"encoder_{i}")(
+                    x, deterministic
+                )
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         x = x[:, 0]  # CLS pool
         logits = nn.Dense(

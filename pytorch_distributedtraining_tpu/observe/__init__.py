@@ -18,6 +18,12 @@ from .hlo import (
     max_all_reduce_elems,
     overlap_audit,
 )
+from .memory import (
+    MemoryStats,
+    compiled_memory_stats,
+    device_hbm_budget,
+    tune_batch_size,
+)
 from .sink import JSONLSink, MetricsSink, NullSink, WandbSink, make_sink
 from .profiling import StepTimer, TransferOverlapProbe, trace
 
@@ -40,4 +46,8 @@ __all__ = [
     "OverlapFinding",
     "overlap_audit",
     "collectives_schedulable",
+    "MemoryStats",
+    "compiled_memory_stats",
+    "device_hbm_budget",
+    "tune_batch_size",
 ]

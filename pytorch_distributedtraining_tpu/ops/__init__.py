@@ -6,6 +6,7 @@ from .ring_attention import (
     ulysses_attention,
 )
 from .collectives import (
+    shard_map,
     all_reduce,
     all_gather,
     reduce_scatter,
@@ -24,6 +25,7 @@ from .collectives import (
 )
 
 __all__ = [
+    "shard_map",
     "all_reduce",
     "all_gather",
     "reduce_scatter",

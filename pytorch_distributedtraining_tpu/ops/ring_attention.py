@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .collectives import shard_map
+
 _BIG_NEG = -1e30
 
 
@@ -129,7 +131,7 @@ def make_ring_attn_fn(
             from ..models.gpt2 import default_attention
 
             return default_attention(q, k, v, causal=causal)
-        return jax.shard_map(
+        return shard_map(
             partial(fn, axis_name=axis_name, causal=causal),
             mesh=mesh,
             in_specs=(spec, spec, spec),

@@ -21,6 +21,13 @@ No bucket loops, no hooks, no wrapper forward: one compiled step.
 """
 
 from .policy import DDP, ZeRO1, ZeRO2, ZeRO3, OSS, ShardedDDP, FSDP, Policy, policy_from_flags
+from .remat import (
+    CHECKPOINT_SAVED_NAMES,
+    REMAT_POLICIES,
+    apply_remat,
+    checkpoint_policy,
+    resolve_remat,
+)
 from .spec import leaf_spec, tree_specs, shard_axis
 from .state import TrainState, create_train_state
 from .step import TrainStep, EvalStep, MultiStep, tune_multi_step_k
@@ -38,6 +45,11 @@ __all__ = [
     "FSDP",
     "Policy",
     "policy_from_flags",
+    "CHECKPOINT_SAVED_NAMES",
+    "REMAT_POLICIES",
+    "apply_remat",
+    "checkpoint_policy",
+    "resolve_remat",
     "leaf_spec",
     "tree_specs",
     "shard_axis",

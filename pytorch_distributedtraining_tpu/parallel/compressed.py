@@ -54,6 +54,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops.collectives import shard_map
 from ..runtime.mesh import batch_spec, data_axes
 from .policy import DDP, Policy
 from .spec import leaf_spec
@@ -250,7 +251,7 @@ class CompressedGradStep:
         lead = (self.axis_name,) + ((self.ici_axis,) if self.ici_axis else ())
         rspec = jax.tree.map(lambda _: P(*lead), residuals)
         bspec = jax.tree.map(lambda _: batch_spec(self.mesh), batch)
-        loss, grads, new_res = jax.shard_map(
+        loss, grads, new_res = shard_map(
             local,
             mesh=self.mesh,
             in_specs=(pspec, rspec, bspec),

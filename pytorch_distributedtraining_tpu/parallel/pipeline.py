@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops.collectives import shard_map
+
 
 def stack_stage_params(params_list):
     """[tree_0, ..., tree_{n-1}] (same structure) -> stacked tree."""
@@ -115,7 +117,7 @@ def pipeline_apply(
         )
     pspec = jax.tree.map(lambda _: P(axis_name), stage_params)
     xspec = P(batch or None, *([None] * (x.ndim - 1)))
-    return jax.shard_map(
+    return shard_map(
         partial(
             _gpipe_local, stage_fn=stage_fn, n_micro=n_micro,
             axis_name=axis_name,

@@ -33,7 +33,10 @@ class Policy:
     shard_opt_state: bool = False
     shard_grads: bool = False
     min_shard_size: int = 1024
-    remat: bool = False  # rematerialize the forward in backward (FSDP memory)
+    # Activation rematerialization in backward (FSDP memory). Accepts a bool
+    # (True == "full") or a named policy from parallel.remat:
+    # "none" | "full" | "dots" | "names" | "offload".
+    remat: bool | str = False
     # DeepSpeed optimizer-offload twin (`Stoke-DDP.py:18` config surface):
     # optimizer state lives in pinned host memory, streamed to the chip for
     # the update. Falls back to HBM on backends without host-placement
@@ -43,6 +46,18 @@ class Policy:
     # streamed to the chip per step (fwd/bwd read them, the update writes
     # back host-side). Same fallback rule as offload_opt_state.
     offload_params: bool = False
+
+    def __post_init__(self):
+        from .remat import resolve_remat
+
+        resolve_remat(self.remat)  # fail at construction, not first step
+
+    @property
+    def remat_policy(self) -> str:
+        """Canonical remat policy name ("none"/"full"/"dots"/...)."""
+        from .remat import resolve_remat
+
+        return resolve_remat(self.remat)
 
     # -- spec builders (trees of PartitionSpec) ----------------------------
 

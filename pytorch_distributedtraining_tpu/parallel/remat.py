@@ -1,0 +1,113 @@
+"""Named activation-rematerialization policies (TorchTitan-style SAC).
+
+The reference surface (`torch.utils.checkpoint` + TorchTitan's selective
+activation checkpointing, PAPERS.md) exposes activation checkpointing as a
+*policy choice*, not a boolean: full recompute, recompute-everything-but-
+matmuls, or save a named subset of activations. This module is the single
+registry mapping those names onto ``jax.checkpoint`` policies so every
+consumer (``TrainStep``, the stoke facade's eager backward, model-internal
+per-block remat under scan) resolves the same spelling to the same policy.
+
+Policies
+--------
+``none``
+    No checkpointing: every forward intermediate stays live for backward.
+    Fastest step, highest activation HBM.
+``full``
+    ``jax.checkpoint`` with the default save-nothing policy: backward
+    recomputes the whole forward (~1/3 extra FLOPs, minimum HBM). This is
+    what ``remat=True`` has always meant here.
+``dots``
+    ``checkpoint_dots``: save matmul/einsum outputs, recompute the cheap
+    elementwise/norm tail. Most of the memory win at a fraction of the
+    recompute cost — the usual sweet spot on matmul-heavy transformers.
+``names``
+    ``save_only_these_names(*CHECKPOINT_SAVED_NAMES)``: save exactly the
+    activations the models tag via ``jax.ad_checkpoint.checkpoint_name``
+    (attention outputs, the expensive-to-recompute softmax+AV product),
+    recompute everything else.
+``offload``
+    ``save_and_offload_only_these_names``: same named subset, but saved to
+    pinned host memory instead of HBM (streamed back for backward). Zero
+    activation HBM for the tagged set; needs a backend with host offload
+    support to pay off.
+
+Booleans remain accepted everywhere for backward compatibility:
+``False → none``, ``True → full``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+# Activation names the model zoo tags with ``checkpoint_name`` — the saved
+# set under the ``names``/``offload`` policies. Attention outputs are the
+# canonical choice (TorchTitan's SAC default): recomputing them in backward
+# costs the full QK^T/softmax/AV chain, while saving them is one [B, T, D]
+# residual per block.
+CHECKPOINT_SAVED_NAMES = ("attn_out",)
+
+REMAT_POLICIES = ("none", "full", "dots", "names", "offload")
+
+
+def resolve_remat(remat: bool | str | None) -> str:
+    """Canonicalize a remat spec (bool | str | None) to a policy name."""
+    if remat is None or remat is False:
+        return "none"
+    if remat is True:
+        return "full"
+    name = str(remat).strip().lower()
+    if name in ("", "0", "false", "off"):
+        return "none"
+    if name in ("1", "true", "on"):
+        return "full"
+    if name not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat policy {remat!r}; valid: "
+            + ", ".join(REMAT_POLICIES)
+            + " (or a bool)"
+        )
+    return name
+
+
+def checkpoint_policy(name: str):
+    """The ``jax.checkpoint`` ``policy=`` value for a canonical name.
+
+    Returns ``None`` for both ``none`` (don't wrap at all — see
+    :func:`apply_remat`) and ``full`` (wrap with jax's default
+    save-nothing policy).
+    """
+    cp = jax.checkpoint_policies
+    if name in ("none", "full"):
+        return None
+    if name == "dots":
+        return cp.checkpoint_dots
+    if name == "names":
+        return cp.save_only_these_names(*CHECKPOINT_SAVED_NAMES)
+    if name == "offload":
+        return cp.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=list(CHECKPOINT_SAVED_NAMES),
+            offload_src="device",
+            offload_dst="pinned_host",
+        )
+    raise ValueError(f"no jax.checkpoint policy for {name!r}")
+
+
+def apply_remat(
+    fn: Callable, remat: bool | str | None, **checkpoint_kwargs
+) -> Callable:
+    """Wrap ``fn`` in ``jax.checkpoint`` under the named policy.
+
+    ``none`` returns ``fn`` unwrapped. Extra kwargs (``static_argnums``,
+    ``prevent_cse``) forward to ``jax.checkpoint``.
+    """
+    name = resolve_remat(remat)
+    if name == "none":
+        return fn
+    policy = checkpoint_policy(name)
+    if policy is None:
+        return jax.checkpoint(fn, **checkpoint_kwargs)
+    return jax.checkpoint(fn, policy=policy, **checkpoint_kwargs)

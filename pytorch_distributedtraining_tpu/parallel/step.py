@@ -31,6 +31,7 @@ from ..optim import FusedAdamW, refresh_params_ema
 from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
 from ..runtime.mesh import batch_spec, stacked_batch_spec
 from .policy import Policy
+from .remat import apply_remat
 from .spec import constrain, stream_to_device
 from .state import TrainState
 
@@ -74,14 +75,15 @@ class TrainStep:
         self.tx = tx
         self.mesh = mesh
         self.policy = policy or Policy()
-        if self.policy.remat:
-            # activation rematerialization (FSDP/DeepSpeed activation-
-            # checkpointing twin at the step level): the backward pass
-            # recomputes the forward instead of holding its activations in
-            # HBM — ~1/3 extra FLOPs for the big memory win. Finer-grained
-            # per-block remat lives in the models' own `remat` flags
-            # (gpt2/vit); both compose (inner checkpoints nest).
-            self.loss_fn = jax.checkpoint(loss_fn)
+        # Activation rematerialization (FSDP/DeepSpeed activation-
+        # checkpointing twin at the step level), resolved through the named
+        # registry (parallel/remat.py): "full" recomputes the whole forward
+        # (~1/3 extra FLOPs for minimum HBM), "dots" saves matmul outputs,
+        # "names"/"offload" save exactly the checkpoint_name-tagged
+        # activations (attention outputs in the model zoo). Finer-grained
+        # per-block remat lives in the models' own `remat` flags
+        # (gpt2/vit/swinir); both compose (inner checkpoints nest).
+        self.loss_fn = apply_remat(loss_fn, self.policy.remat)
         self.grad_accum_steps = int(grad_accum_steps)
         self.precision = precision or PrecisionPolicy()
         self.loss_scaler = loss_scaler
@@ -393,6 +395,22 @@ class TrainStep:
                 .compile()
                 .as_text()
             )
+
+    def memory_analysis(self, state: TrainState, batch, lr_factor: float = 1.0):
+        """Compiler memory accounting for this step (`observe.memory`).
+
+        Returns a :class:`~..observe.memory.MemoryStats` (peak / argument /
+        temp bytes per device) or ``None`` when the backend's compiler
+        doesn't report memory. Costs an AOT compile — with the persistent
+        compilation cache enabled the XLA work is a disk deserialize.
+        """
+        from ..observe.memory import compiled_memory_stats
+
+        with self.mesh:
+            compiled = self._jitted.lower(
+                state, batch, jnp.float32(lr_factor)
+            ).compile()
+        return compiled_memory_stats(compiled)
 
     def __call__(self, state: TrainState, batch, lr_factor: float = 1.0):
         return self._jitted(state, batch, jnp.float32(lr_factor))

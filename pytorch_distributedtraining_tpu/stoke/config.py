@@ -84,7 +84,10 @@ class TPUConfig:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
-    remat: bool = False  # activation rematerialization in the train step
+    # Activation rematerialization in the train step: bool (True == "full")
+    # or a named policy ("none"/"full"/"dots"/"names"/"offload" — see
+    # parallel/remat.py). Unset falls back to the GRAFT_REMAT env knob.
+    remat: bool | str = False
     donate_state: bool = True
 
 

@@ -22,6 +22,7 @@ Grad accumulation follows Stoke semantics: ``.backward`` scales by
 
 from __future__ import annotations
 
+import dataclasses
 import inspect
 import os
 import threading
@@ -38,6 +39,7 @@ from .. import optim as optim_mod
 from ..data import DataLoader as _DataLoader
 from ..ops import sync_scalar_device
 from ..parallel import TrainStep, create_train_state, policy_from_flags
+from ..parallel.remat import apply_remat, resolve_remat
 from ..parallel.spec import constrain, shard_axis, stream_to_device
 from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
 from ..runtime import dist as _dist
@@ -55,6 +57,42 @@ from .config import (
     TPUConfig,
 )
 from .optimizer import StokeOptimizer
+
+
+def _remat_from_env(configured):
+    """Resolve the effective remat policy: explicit TPUConfig wins, else the
+    ``GRAFT_REMAT`` env supplies one ("none"/"full"/"dots"/"names"/
+    "offload"), else off. Validated here so a typo fails at construction."""
+    if configured:  # explicit config (True or a named policy) wins
+        return configured
+    env = os.environ.get("GRAFT_REMAT")
+    if env is None:
+        return configured
+    return resolve_remat(env)
+
+
+def _apply_scan_layers_env(model):
+    """``GRAFT_SCAN_LAYERS=1|0`` flips a model's ``scan_layers`` flag.
+
+    Deploy-time twin of the model constructor arg. Covers both flag
+    placements: a direct module field (SwinIR) and a ``cfg`` dataclass
+    field (GPT2/ViT). Models without the flag (or a non-flax wrapper)
+    pass through untouched, so the env is safe to export globally.
+    """
+    env = os.environ.get("GRAFT_SCAN_LAYERS")
+    if env is None or not hasattr(model, "clone"):
+        return model
+    want = env.strip().lower() in ("1", "true", "on", "yes")
+    if hasattr(model, "scan_layers"):
+        if bool(model.scan_layers) == want:
+            return model
+        return model.clone(scan_layers=want)
+    cfg = getattr(model, "cfg", None)
+    if cfg is not None and hasattr(cfg, "scan_layers"):
+        if bool(cfg.scan_layers) == want:
+            return model
+        return model.clone(cfg=dataclasses.replace(cfg, scan_layers=want))
+    return model
 
 
 @jax.jit
@@ -332,7 +370,7 @@ class Stoke:
         fused_optimizer: bool | None = None,
     ):
         _dist.initialize()
-        self._module = model
+        self._module = _apply_scan_layers_env(model)
         self._loss_callable = loss
         self.batch_size_per_device = int(batch_size_per_device)
         self.verbose = bool(verbose)
@@ -406,7 +444,7 @@ class Stoke:
             fairscale_oss=fairscale_oss,
             fairscale_sddp=fairscale_sddp,
             fairscale_fsdp=fairscale_fsdp,
-            remat=self.tpu_config.remat,
+            remat=_remat_from_env(self.tpu_config.remat),
             offload_opt_state=offload_opt,
             offload_params=offload_par,
         )
@@ -632,11 +670,11 @@ class Stoke:
             loss = loss_callable(out, y)
             return loss, precision.cast_to_output(out), new_state
 
-        if self.policy.remat:
-            # the eager .backward() path honors Policy.remat too (the
-            # fused TrainStep wires it separately): backward recomputes
-            # the forward instead of holding its activations
-            fwd_loss = jax.checkpoint(fwd_loss)
+        # the eager .backward() path honors Policy.remat too (the fused
+        # TrainStep wires it separately), resolved through the same named
+        # registry: "full" recomputes the forward, "dots"/"names"/"offload"
+        # save the policy's subset (parallel/remat.py)
+        fwd_loss = apply_remat(fwd_loss, self.policy.remat)
 
         def loss_grad(params, model_state, x, y, rng, scaler_state):
             # stream BEFORE value_and_grad: differentiating through the

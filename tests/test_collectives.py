@@ -5,9 +5,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from pytorch_distributedtraining_tpu import ops
+from pytorch_distributedtraining_tpu.ops.collectives import shard_map
 
 
 def _run(mesh, fn, x, in_spec=P("dp"), out_spec=P("dp"), check_vma=True):

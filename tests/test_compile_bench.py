@@ -1,0 +1,46 @@
+"""compile_bench.py end-to-end: scan-over-layers cuts cold-compile time.
+
+ISSUE 3 acceptance: the scanned arm's cold compile must beat the unrolled
+arm's on CPU. Runs the real benchmark script (subprocess, tiny program so
+the suite stays bounded) and asserts on its JSON summary. Marked slow —
+two full XLA compiles are seconds even at toy sizes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_scan_cold_compile_beats_loop():
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        GRAFT_COMPILE_BENCH_DEPTH="4",
+        GRAFT_COMPILE_BENCH_BLOCKS="1",
+        GRAFT_COMPILE_BENCH_DIM="20",
+        GRAFT_COMPILE_BENCH_BATCH="1",
+        GRAFT_COMPILE_BENCH_PATCH="16",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "compile_bench.py")],
+        capture_output=True, text=True, timeout=560, env=env,
+        cwd=os.path.join(REPO, "benchmarks"),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            row = json.loads(line)
+            if row.get("summary") == "compile_bench":
+                summary = row
+    assert summary is not None, proc.stdout
+    assert summary["scan_cold_s"] < summary["loop_cold_s"], summary
+    # cached arms exercise the persistent cache: entries must exist
+    assert summary["loop_cache_speedup"] > 0, summary

@@ -111,7 +111,9 @@ def test_quantize_roundtrip_unbiased_over_steps():
     mesh = make_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
     from jax.sharding import PartitionSpec as P
 
-    acc, g = jax.jit(jax.shard_map(
+    from pytorch_distributedtraining_tpu.ops.collectives import shard_map
+
+    acc, g = jax.jit(shard_map(
         lambda: run(), mesh=mesh, in_specs=(), out_specs=(P(), P()),
         check_vma=False,
     ))()
