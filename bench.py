@@ -714,6 +714,67 @@ def _probe() -> None:
         sys.exit(3)
 
 
+def _pipeline_probe_peak(pp: int, schedule: str, n_micro: int):
+    """Compiled peak-memory plan of a small stacked-trunk PipelineStep.
+
+    Probe-sized on purpose (tiny MLP blocks): the number is pipeline
+    *provenance* for the bench record — the engine's residency behavior
+    under this schedule — not the ESPCN step's footprint. Returns
+    ``peak_bytes`` or None when the backend reports no memory analysis.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributedtraining_tpu import optim
+    from pytorch_distributedtraining_tpu.parallel import (
+        PipelineStep,
+        Policy,
+        create_train_state,
+        pipeline_state_shardings,
+    )
+    from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
+
+    v = 2 if schedule == "interleaved" else 1
+    d, layers, batch_n = 64, pp * v, 8 * n_micro
+    mesh = make_mesh(MeshSpec(pp=pp), devices=jax.devices()[:pp])
+
+    def init_fn(rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "h": {
+                "w": jax.random.normal(k1, (layers, d, d)) * 0.1,
+                "b": jnp.zeros((layers, d)),
+            },
+            "out": jax.random.normal(k2, (d, 1)) * 0.1,
+        }, {}
+
+    tx = optim.adamw(lr=1e-3)
+    state, shardings = create_train_state(
+        init_fn=init_fn, tx=tx, mesh=mesh, policy=Policy()
+    )
+    shardings = pipeline_state_shardings(shardings, state, mesh, "h")
+    state = jax.device_put(state, shardings)
+    step = PipelineStep(
+        lambda p, x: jnp.tanh(x @ p["w"] + p["b"]),
+        tx,
+        mesh,
+        Policy(),
+        n_micro=n_micro,
+        schedule=schedule,
+        v=v,
+        stages_key="h",
+        head_fn=lambda o, y, mb, rng: jnp.mean((y @ o["out"] - mb[1]) ** 2),
+        state_shardings=shardings,
+        donate=False,
+    )
+    batch = (
+        jnp.zeros((batch_n, d), jnp.float32),
+        jnp.zeros((batch_n, 1), jnp.float32),
+    )
+    mem = step.memory_analysis(state, batch)
+    return None if mem is None else mem.peak_bytes
+
+
 def _bench() -> None:
     fault_point("bench.child")  # chaos hook: die mid-attempt on schedule
     t_child_start = time.perf_counter()  # time-to-first-step clock: backend
@@ -794,14 +855,14 @@ def _bench() -> None:
             raise SystemExit(f"bench_knobs.json unreadable: {e}")
         unknown = set(knobs) - {
             "attn", "attn_pack", "norm", "softmax", "opt", "loop", "scan_k",
-            "feed", "remat", "scan_layers",
+            "feed", "remat", "scan_layers", "pp", "pp_schedule", "pp_micro",
         }
         if unknown:
             # a typoed key would otherwise silently no-op the default flip
             raise SystemExit(
                 f"bench_knobs.json unknown keys {sorted(unknown)}; valid: "
                 "attn, attn_pack, norm, softmax, opt, loop, scan_k, feed, "
-                "remat, scan_layers"
+                "remat, scan_layers, pp, pp_schedule, pp_micro"
             )
 
     resolved = {}  # effective value + where it came from, for the log line
@@ -901,6 +962,20 @@ def _bench() -> None:
         raise SystemExit(
             f"scan_k must be an int, got {scan_k_str!r} "
             f"(from {resolved['scan_k'][1]})"
+        )
+    # pipeline knobs (parallel/pipeline.py): pp>1 adds an untimed pipeline
+    # probe (schedule bubble math + PipelineStep compiled memory plan) so
+    # the record carries pp provenance; the timed ESPCN windows stay
+    # single-device (the pipelined A/B lives in benchmarks/pipeline_bench)
+    pp_str = knob("GRAFT_PP", "pp", "1")
+    pp_schedule_impl = knob("GRAFT_PP_SCHEDULE", "pp_schedule", "1f1b")
+    pp_micro_str = knob("GRAFT_PP_MICRO", "pp_micro", "0")
+    try:
+        pp_impl = int(pp_str)
+        pp_micro_impl = int(pp_micro_str)
+    except ValueError:
+        raise SystemExit(
+            f"pp/pp_micro must be ints, got {pp_str!r}/{pp_micro_str!r}"
         )
     if any(src != "default" for _, src in resolved.values()):
         # the EFFECTIVE config (env > json > default), not the raw file —
@@ -1211,6 +1286,37 @@ def _bench() -> None:
             )
     except Exception as e:  # noqa: BLE001 — accounting must not kill a run
         print(f"# child: memory analysis unavailable: {e}", flush=True)
+    # pipeline provenance (untimed): pp>1 resolves the schedule table for
+    # its analytic bubble fraction and — when the backend has the devices —
+    # compiles a small stacked-trunk PipelineStep for the XLA memory plan
+    # (pp_peak_residency_bytes; the measured GPipe-vs-1F1B A/B lives in
+    # benchmarks/pipeline_bench.py)
+    bubble_fraction = None
+    pp_peak_residency_bytes = None
+    if pp_impl > 1:
+        try:
+            from pytorch_distributedtraining_tpu.parallel.pipeline import (
+                build_schedule,
+            )
+
+            pp_n_micro = pp_micro_impl or 2 * pp_impl
+            pp_v = 2 if pp_schedule_impl == "interleaved" else 1
+            sched = build_schedule(
+                pp_schedule_impl, pp_impl, pp_n_micro, v=pp_v
+            )
+            bubble_fraction = round(sched.bubble_fraction, 4)
+            if jax.device_count() >= pp_impl:
+                pp_peak_residency_bytes = _pipeline_probe_peak(
+                    pp_impl, pp_schedule_impl, pp_n_micro
+                )
+                print(
+                    f"# child: pipeline probe pp={pp_impl} "
+                    f"{pp_schedule_impl} bubble={bubble_fraction} peak="
+                    f"{pp_peak_residency_bytes}",
+                    flush=True,
+                )
+        except Exception as e:  # noqa: BLE001 — provenance, not the metric
+            print(f"# child: pipeline probe unavailable: {e}", flush=True)
     cache_entries_now = cache_entry_count(cache_path)
     compile_cache = {
         "enabled": cache_path is not None,
@@ -1246,6 +1352,10 @@ def _bench() -> None:
                 "peak_hbm_bytes": peak_hbm_bytes,
                 "remat": remat_impl,
                 "scan_layers": scan_layers,
+                "pp": pp_impl,
+                "pp_schedule": pp_schedule_impl if pp_impl > 1 else None,
+                "bubble_fraction": bubble_fraction,
+                "pp_peak_residency_bytes": pp_peak_residency_bytes,
             }
         )
     )

@@ -27,6 +27,8 @@ STAGES = [
     ("bench_remat", "bench.py, GRAFT_REMAT=full (activation remat arm)"),
     ("bench_scan_layers", "bench.py, GRAFT_SCAN_LAYERS=1 (scanned RSTBs)"),
     ("prefetch", "device-prefetch sync vs depth 1/2/3 (prefetch_bench.py)"),
+    ("pipeline", "GPipe vs 1F1B vs interleaved schedules (pipeline_bench.py)"),
+    ("bench_pp", "bench.py, GRAFT_PP=4 (pipeline provenance probe arm)"),
     ("bench_resident", "bench.py, GRAFT_BENCH_FEED=resident (no input pipe)"),
     # round-5 chain stage names (benchmarks/tpu_chain.sh r5)
     ("dispatch_probe", "tunnel dispatch-cost decomposition (dispatch_probe.py)"),
@@ -79,6 +81,7 @@ ARM_KNOBS = {
     "bench_resident": "GRAFT_BENCH_FEED=resident",
     "bench_remat": "GRAFT_REMAT=full",
     "bench_scan_layers": "GRAFT_SCAN_LAYERS=1",
+    "bench_pp": "GRAFT_PP=4 GRAFT_PP_SCHEDULE=1f1b",
 }
 
 

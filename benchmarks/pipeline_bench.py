@@ -1,0 +1,208 @@
+"""Pipeline-schedule microbenchmark: GPipe vs 1F1B vs interleaved-1F1B.
+
+Runs the SAME stacked-MLP trunk (2*pp layers, identical total work)
+through :class:`parallel.PipelineStep` under each schedule plus a pp=1
+reference arm, and reports per arm:
+
+- ``step_ms``            timed optimizer-step wall time (median of STEPS)
+- ``bubble_analytic``    the schedule table's idle fraction
+- ``bubble_measured``    1 - (t_pp1 / pp) / t_arm — the idle fraction
+                         implied by wall time against perfect scaling of
+                         the single-device reference (CPU numbers prove
+                         the plumbing; judge the gap on a real chip)
+- ``res_slots``          residual buffer slots the schedule allocates
+                         (the O(N) vs O(M) activation-residency story)
+- ``peak_bytes`` / ``temp_bytes``  compiler memory plan of the compiled
+                         step (``observe.memory.compiled_memory_stats``)
+
+The summary line asserts the tentpole property: at M >= 2N the 1F1B
+arm's residual slots AND compiled scratch bytes sit strictly below
+GPipe's. On CPU the harness re-execs nothing: set 8 host devices via
+``GRAFT_PIPELINE_BENCH_DEVICES`` (default 8 when the backend is CPU) so
+pp=4 schedules run anywhere.
+
+``GRAFT_PIPELINE_BENCH_STEPS`` / ``_DIM`` / ``_MICRO_B`` resize the run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+# must land before the first jax import creates the backend: CPU runs get
+# enough host devices for a real pp axis (inert when XLA_FLAGS already
+# pins a count, e.g. under the multichip dryrun driver)
+_ndev = int(os.environ.get("GRAFT_PIPELINE_BENCH_DEVICES", "0"))
+if _ndev == 0 and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    _ndev = 8
+if _ndev > 1 and "host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={_ndev}"
+    ).strip()
+
+import numpy as np
+
+from _roofline import guard, verify_finite
+
+STEPS = int(os.environ.get("GRAFT_PIPELINE_BENCH_STEPS", "20"))
+DIM = int(os.environ.get("GRAFT_PIPELINE_BENCH_DIM", "256"))
+MICRO_B = int(os.environ.get("GRAFT_PIPELINE_BENCH_MICRO_B", "32"))
+
+
+def _build_step(schedule: str, pp: int, n_micro: int, layers: int, mesh):
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributedtraining_tpu import optim
+    from pytorch_distributedtraining_tpu.parallel import (
+        PipelineStep,
+        Policy,
+        create_train_state,
+        pipeline_state_shardings,
+    )
+
+    v = 2 if schedule == "interleaved" else 1
+
+    def init_fn(rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "h": {
+                "w": jax.random.normal(k1, (layers, DIM, DIM)) * 0.1,
+                "b": jnp.zeros((layers, DIM)),
+            },
+            "out": jax.random.normal(k2, (DIM, 1)) * 0.1,
+        }, {}
+
+    tx = optim.adamw(lr=1e-3)
+    state, shardings = create_train_state(
+        init_fn=init_fn, tx=tx, mesh=mesh, policy=Policy()
+    )
+    shardings = pipeline_state_shardings(shardings, state, mesh, "h")
+    state = jax.device_put(state, shardings)
+    step = PipelineStep(
+        lambda p, x: jnp.tanh(x @ p["w"] + p["b"]),
+        tx,
+        mesh,
+        Policy(),
+        n_micro=n_micro,
+        schedule=schedule,
+        v=v,
+        stages_key="h",
+        head_fn=lambda o, y, mb, rng: jnp.mean((y @ o["out"] - mb[1]) ** 2),
+        state_shardings=shardings,
+        donate=False,
+    )
+    return step, state
+
+
+def _run_arm(arm: str, schedule: str, pp: int, n_micro: int, layers: int):
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributedtraining_tpu.runtime.mesh import (
+        MeshSpec, make_mesh,
+    )
+
+    mesh = make_mesh(MeshSpec(pp=pp), devices=jax.devices()[:pp])
+    step, state = _build_step(schedule, pp, n_micro, layers, mesh)
+    batch_n = n_micro * MICRO_B
+    rng = np.random.default_rng(0)
+    batch = (
+        jnp.asarray(rng.normal(size=(batch_n, DIM)), jnp.float32),
+        jnp.asarray(rng.normal(size=(batch_n, 1)), jnp.float32),
+    )
+    mem = step.memory_analysis(state, batch)  # also warms the compile
+    state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    times = []
+    for _ in range(STEPS):
+        t0 = time.perf_counter()
+        state, metrics = step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        times.append(time.perf_counter() - t0)
+    step_s = float(np.median(times))
+    verify_finite(float(metrics["loss"]), f"{arm} loss")
+
+    # matmul-only FLOP floor (fwd + ~2x bwd), generous roofline per chip
+    flops = 3 * 2 * layers * batch_n * DIM * DIM
+    tflops = flops / step_s / 1e12
+    guard(
+        f"pipeline_bench {arm}", tflops, "TFLOP/s", 1000.0 * pp,
+        "1 PFLOP/s per chip is above any current part",
+    )
+
+    row = {
+        "arm": arm,
+        "schedule": schedule,
+        "pp": pp,
+        "n_micro": n_micro,
+        "v": step.schedule.v,
+        "step_ms": round(step_s * 1e3, 3),
+        "bubble_analytic": round(step.schedule.bubble_fraction, 4),
+        "res_slots": step.schedule.res_slots,
+        "ticks": step.schedule.n_ticks,
+        "peak_bytes": None if mem is None else mem.peak_bytes,
+        "temp_bytes": None if mem is None else mem.temp_bytes,
+    }
+    return row
+
+
+def main() -> None:
+    import jax
+
+    pp = min(4, jax.device_count())
+    n_micro = 2 * pp  # M = 2N: the regime where 1F1B's O(N) bound bites
+    layers = 2 * pp  # lpv=2 at v=1, lpv=1 for the interleaved v=2 arm
+
+    rows = []
+    # pp=1 reference: same trunk, one device, zero bubble by construction
+    ref = _run_arm("pp1_ref", "gpipe", 1, n_micro, layers)
+    print(json.dumps(ref), flush=True)
+    t_ideal = ref["step_ms"] / pp  # perfect-scaling per-rank work estimate
+
+    for schedule in ("gpipe", "1f1b", "interleaved"):
+        if pp == 1:
+            break
+        row = _run_arm(schedule, schedule, pp, n_micro, layers)
+        row["bubble_measured"] = round(
+            max(0.0, 1.0 - t_ideal / row["step_ms"]), 4
+        )
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    summary = {
+        "summary": "pipeline_bench",
+        "pp": pp,
+        "n_micro": n_micro,
+        "platform": jax.devices()[0].platform,
+        "pp1_step_ms": ref["step_ms"],
+    }
+    by = {r["schedule"]: r for r in rows}
+    if "gpipe" in by and "1f1b" in by:
+        g, f = by["gpipe"], by["1f1b"]
+        summary["res_slots_gpipe"] = g["res_slots"]
+        summary["res_slots_1f1b"] = f["res_slots"]
+        ok = f["res_slots"] < g["res_slots"]
+        if g["temp_bytes"] and f["temp_bytes"]:
+            summary["temp_bytes_gpipe"] = g["temp_bytes"]
+            summary["temp_bytes_1f1b"] = f["temp_bytes"]
+            ok = ok and f["temp_bytes"] < g["temp_bytes"]
+        summary["residency_1f1b_below_gpipe"] = ok
+        if not ok:
+            print(json.dumps(summary), flush=True)
+            raise SystemExit(
+                "1F1B residency not strictly below GPipe at M=2N — "
+                "the schedule engine's O(N) bound regressed"
+            )
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -103,6 +103,12 @@ full_chain() {
   # the user-facing tuner API on the flagship step (should resolve to
   # k=1 if the scan anomaly persists — that resolution is the feature)
   run tune_probe 700 python benchmarks/tune_probe.py
+  # pipeline schedules head-to-head: 1F1B residency must undercut GPipe
+  # at M=2N; the bench SystemExits if the O(N) bound regressed
+  run pipeline 600 python benchmarks/pipeline_bench.py
+  # bench.py pipeline provenance arm: records pp/pp_schedule/
+  # bubble_fraction/pp_peak_residency_bytes in the JSON envelope
+  run bench_pp 540 env GRAFT_BENCH_KNOBS=0 GRAFT_BENCH_TOTAL=500 GRAFT_PP=4 GRAFT_PP_SCHEDULE=1f1b python bench.py
   # five-config ladder at sustained 200-step best-of-3 (VERDICT #6)
   run ladder_all 1800 python benchmarks/ladder.py --all --steps 200
   # Pallas crossover hunt at long sequence (VERDICT #9)

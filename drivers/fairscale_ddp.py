@@ -59,7 +59,21 @@ TARGET_PATH = "/opt/hubshare/vectorly-share/shared/Image_Superresolution/Dataset
 def train(rank: int, world_size: int, epochs: int, opt=None):
     # process-group init twin (Fairscale-DDP.py:27): env:// rendezvous
     runtime.initialize()
-    mesh = make_mesh(MeshSpec.zero())
+    pp = max(1, int(getattr(opt, "pp", 1)))
+    if pp > 1:
+        # --pp shapes the mesh with a pipeline axis (remaining devices on
+        # the sharded-DP axis). ESPCN has no uniform stacked stage trunk,
+        # so the TrainStep below replicates over pp — a mesh-shape smoke
+        # path; the schedule-driven engine is parallel.PipelineStep.
+        import jax as _jax
+
+        fsdp = max(1, _jax.device_count() // pp)
+        print(f"--pp={pp} ({getattr(opt, 'pp_schedule', '1f1b')}): mesh "
+              f"fsdp={fsdp} x pp={pp}; ESPCN has no stacked stages, pp "
+              "ranks replicate (see parallel.PipelineStep)")
+        mesh = make_mesh(MeshSpec(fsdp=fsdp, pp=pp))
+    else:
+        mesh = make_mesh(MeshSpec.zero())
 
     print("===> Loading datasets")
     input_path = getattr(opt, "input_dir", INPUT_PATH)
@@ -164,6 +178,19 @@ def main(argv=None):
                         help="activation remat policy for the step: "
                              "none/full/dots/names/offload "
                              "(default: $GRAFT_REMAT or none)")
+    parser.add_argument("--pp", type=int,
+                        default=int(os.environ.get("GRAFT_PP", "1")),
+                        help="pipeline-parallel mesh axis size (env twin "
+                             "$GRAFT_PP). ESPCN has no uniform stacked "
+                             "stage trunk, so pp>1 only shapes the mesh "
+                             "here (pp ranks replicate); the schedule-"
+                             "driven engine is parallel.PipelineStep")
+    parser.add_argument("--pp-schedule", type=str,
+                        default=os.environ.get("GRAFT_PP_SCHEDULE", "1f1b"),
+                        choices=["gpipe", "1f1b", "interleaved"],
+                        help="pipeline schedule (env twin "
+                             "$GRAFT_PP_SCHEDULE); recorded for tooling "
+                             "parity with bench.py")
     opt = parser.parse_args(argv)
 
     # GRAFT_PLATFORM=cpu forces the backend (see runtime.dist docstring:

@@ -186,6 +186,19 @@ def build_parser():
                         help="activation remat policy per Swin layer/pair: "
                              "none/full/dots/names/offload "
                              "(default: $GRAFT_REMAT or none)")
+    parser.add_argument("--pp", type=int,
+                        default=int(os.environ.get("GRAFT_PP", "1")),
+                        help="pipeline-parallel mesh axis size (env twin "
+                             "$GRAFT_PP). SwinIR has no uniform stacked "
+                             "stage trunk, so on this driver pp>1 only "
+                             "shapes the mesh (pp ranks replicate); the "
+                             "schedule-driven engine is parallel."
+                             "PipelineStep (see docs/PARALLELISM.md)")
+    parser.add_argument("--pp-schedule", type=str,
+                        default=os.environ.get("GRAFT_PP_SCHEDULE", "1f1b"),
+                        choices=["gpipe", "1f1b", "interleaved"],
+                        help="pipeline schedule for pipelined steps (env "
+                             "twin $GRAFT_PP_SCHEDULE)")
     return parser
 
 
@@ -233,6 +246,14 @@ def main(argv=None):
         print(f"===> scan_layers={opt.scan_layers} remat={remat}")
 
     loss = feat_loss
+
+    # --pp/--pp-schedule thread the pipeline knobs through their env twins
+    # (the facade reads $GRAFT_PP/$GRAFT_PP_SCHEDULE when sizing the mesh)
+    if opt.pp > 1:
+        os.environ["GRAFT_PP"] = str(opt.pp)
+        os.environ["GRAFT_PP_SCHEDULE"] = opt.pp_schedule
+        print(f"===> pp={opt.pp} schedule={opt.pp_schedule} "
+              "(mesh axis only on this driver; see --help)")
 
     optimizer = StokeOptimizer(
         optimizer="AdamW",

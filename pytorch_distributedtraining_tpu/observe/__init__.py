@@ -11,12 +11,14 @@ from .hlo import (
     CollectiveOp,
     OverlapAudit,
     OverlapFinding,
+    PipelineAudit,
     collective_inventory,
     collectives_schedulable,
     counts,
     has_logical_reduce_scatter,
     max_all_reduce_elems,
     overlap_audit,
+    pipeline_audit,
 )
 from .memory import (
     MemoryStats,
@@ -46,6 +48,8 @@ __all__ = [
     "OverlapFinding",
     "overlap_audit",
     "collectives_schedulable",
+    "PipelineAudit",
+    "pipeline_audit",
     "MemoryStats",
     "compiled_memory_stats",
     "device_hbm_budget",

@@ -300,3 +300,131 @@ def collectives_schedulable(hlo_text: str) -> bool:
     Vacuously True for a module with no collectives (single-device step).
     """
     return overlap_audit(hlo_text).ok
+
+
+# -- pipeline wire audit ------------------------------------------------------
+
+_PAIR_RE = re.compile(r"\{(\d+),(\d+)\}")
+_PAIRS_ATTR_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)*)\}")
+
+
+@dataclass(frozen=True)
+class PipelineAudit:
+    """Verdict: does a compiled step's wire plan match its schedule table?
+
+    The pipeline executor runs one scan per schedule *segment* and emits
+    the fwd/bwd ``ppermute`` hop only in segments that move data on that
+    channel — so the ``collective-permute`` instruction count is a
+    schedule fingerprint (GPipe's disjoint phases: 2; 1F1B's steady state:
+    more). ``fwd_instructions``/``bwd_instructions`` classify each
+    instruction's ``source_target_pairs`` against the schedule's ring for
+    that channel mapped onto concrete device ids (-1 = no mesh supplied,
+    classification skipped).
+    """
+
+    schedule: str
+    expected_permutes: int
+    found_permutes: int
+    expected_fwd: int
+    expected_bwd: int
+    fwd_instructions: int
+    bwd_instructions: int
+    unmatched: tuple  # HLO lines whose pair set matched neither channel
+
+    @property
+    def count_ok(self) -> bool:
+        return self.found_permutes == self.expected_permutes
+
+    @property
+    def pairs_ok(self) -> bool:
+        """Channel-level check (requires a mesh; vacuous without one)."""
+        if self.fwd_instructions < 0:
+            return True
+        return (
+            not self.unmatched
+            and self.fwd_instructions == self.expected_fwd
+            and self.bwd_instructions == self.expected_bwd
+        )
+
+    @property
+    def ok(self) -> bool:
+        return self.count_ok and self.pairs_ok
+
+
+def _channel_device_pairs(mesh, axis_name: str, logical_pairs) -> frozenset:
+    """Map a channel's logical (rank, rank) pairs to global device-id pairs.
+
+    The SPMD partitioner emits ONE collective-permute covering every
+    cross-section of the other mesh axes (each dp/fsdp replica permutes
+    within its own pp ring), so the instruction's pair list is the union
+    over those cross-sections.
+    """
+    import numpy as _np
+
+    ax = list(mesh.axis_names).index(axis_name)
+    rings = _np.moveaxis(mesh.devices, ax, -1).reshape(-1, mesh.shape[axis_name])
+    return frozenset(
+        (ring[a].id, ring[b].id) for ring in rings for a, b in logical_pairs
+    )
+
+
+def pipeline_audit(hlo_text: str, schedule, mesh=None, axis_name: str = "pp"):
+    """Audit a compiled pipeline step against its schedule table.
+
+    ``schedule`` is a ``parallel.PipelineSchedule``. Counts the module's
+    ``collective-permute`` instructions against
+    ``schedule.expected_collective_permutes`` and — when ``mesh`` is given
+    — checks every instruction's ``source_target_pairs`` is exactly the
+    fwd or bwd channel ring (wrap pairs present iff the schedule is
+    interleaved), with per-channel instruction counts matching the
+    segment table. Run it on ``PipelineStep.compiled_text(...)``.
+    """
+    found: list[tuple[frozenset, str]] = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if m is None or m.group(1) != "collective-permute":
+            continue
+        pm = _PAIRS_ATTR_RE.search(line)
+        pairs = frozenset(
+            (int(a), int(b)) for a, b in _PAIR_RE.findall(pm.group(1))
+        ) if pm else frozenset()
+        found.append((pairs, line.strip()))
+
+    expected_fwd = sum(1 for _, _, f, _ in schedule.segments if f)
+    expected_bwd = sum(1 for _, _, _, b in schedule.segments if b)
+    nf = nb = -1
+    unmatched: list[str] = []
+    if mesh is not None:
+        fset = _channel_device_pairs(
+            mesh, axis_name, schedule.permute_pairs("fwd")
+        )
+        bset = _channel_device_pairs(
+            mesh, axis_name, schedule.permute_pairs("bwd")
+        )
+        nf = nb = matched = 0
+        for pairs, line in found:
+            if fset == bset and pairs == fset:
+                matched += 1
+            elif pairs == fset:
+                nf += 1
+            elif pairs == bset:
+                nb += 1
+            else:
+                unmatched.append(line)
+        if fset == bset:
+            # n_stages=2 full ring: both channels are {(0,1),(1,0)} so the
+            # pair set can't tell them apart — only the total is checkable
+            if matched == expected_fwd + expected_bwd:
+                nf, nb = expected_fwd, expected_bwd
+            else:
+                nf, nb = matched, 0
+    return PipelineAudit(
+        schedule=schedule.name,
+        expected_permutes=schedule.expected_collective_permutes,
+        found_permutes=len(found),
+        expected_fwd=expected_fwd,
+        expected_bwd=expected_bwd,
+        fwd_instructions=nf,
+        bwd_instructions=nb,
+        unmatched=tuple(unmatched),
+    )

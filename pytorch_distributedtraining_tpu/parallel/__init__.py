@@ -33,7 +33,17 @@ from .state import TrainState, create_train_state
 from .step import TrainStep, EvalStep, MultiStep, tune_multi_step_k
 from .compressed import CompressedGradStep
 from .tensor import MEGATRON_RULES, TensorParallel, tp_zero1, tp_zero3
-from .pipeline import pipeline_apply, stack_stage_params, unstack_stage_params
+from .pipeline import (
+    SCHEDULES,
+    PipelineSchedule,
+    PipelineStep,
+    build_schedule,
+    pipeline_apply,
+    pipeline_state_shardings,
+    pipeline_value_and_grad,
+    stack_stage_params,
+    unstack_stage_params,
+)
 
 __all__ = [
     "DDP",
@@ -64,7 +74,13 @@ __all__ = [
     "TensorParallel",
     "tp_zero1",
     "tp_zero3",
+    "SCHEDULES",
+    "PipelineSchedule",
+    "PipelineStep",
+    "build_schedule",
     "pipeline_apply",
+    "pipeline_state_shardings",
+    "pipeline_value_and_grad",
     "stack_stage_params",
     "unstack_stage_params",
 ]

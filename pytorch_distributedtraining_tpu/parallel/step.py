@@ -16,6 +16,12 @@ Contract for ``loss_fn``::
 ``aux_dict`` may carry a ``"model_state"`` entry (updated mutable
 collections, e.g. sync-BN stats) which replaces ``state.model_state``;
 other entries are reported as metrics (averaged over microbatches).
+
+Siblings with the same optimizer/donation/metrics contract: ``MultiStep``
+(k steps per dispatch), ``CompressedGradStep`` (grad wire compression),
+and ``parallel.pipeline.PipelineStep`` — the schedule-driven pipeline
+engine for meshes with a "pp" axis (this class does NOT pipeline; it
+warns if handed one).
 """
 
 from __future__ import annotations
@@ -145,6 +151,21 @@ class TrainStep:
                 "flat moments resolved to fully replicated (mesh axis "
                 "does not divide the padded length?) — the ZeRO-1 memory "
                 "saving is not in effect",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        if mesh.shape.get("pp", 1) > 1:
+            # TrainStep has no stage placement: on a pp mesh the whole
+            # model replicates across pp ranks and the axis computes the
+            # same step N times — almost certainly not what was meant
+            import warnings
+
+            warnings.warn(
+                "TrainStep on a mesh with a pp axis of size "
+                f"{mesh.shape['pp']}: the step does not pipeline — the pp "
+                "ranks run replicated, identical work. Use "
+                "parallel.PipelineStep (schedule-driven 1F1B engine) for "
+                "pipeline parallelism",
                 RuntimeWarning,
                 stacklevel=2,
             )

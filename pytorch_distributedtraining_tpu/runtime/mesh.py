@@ -191,9 +191,13 @@ def data_axes(mesh: Mesh) -> tuple:
     """Axes a global batch is sharded over.
 
     Only dp/fsdp — NOT "pp": pipeline stages hold different layers and must
-    see the same microbatches, so the batch is never split over pp.
+    see the same microbatches, so the batch is never split over pp. On a
+    mesh with no data axis at all (e.g. pure-pp) the batch is replicated.
     """
-    return tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1) or ("dp",)
+    axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    if axes:
+        return axes
+    return ("dp",) if "dp" in mesh.axis_names else ()
 
 
 def batch_spec(mesh: Mesh) -> P:

@@ -84,6 +84,13 @@ class TPUConfig:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
+    # Pipeline parallelism (parallel/pipeline.py): pp = stage count (mesh
+    # axis size), pp_schedule = "gpipe"|"1f1b"|"interleaved", pp_micro =
+    # microbatches per data shard (0 -> engine default). Env twins:
+    # $GRAFT_PP / $GRAFT_PP_SCHEDULE / $GRAFT_PP_MICRO override these.
+    pp: int = 1
+    pp_schedule: str = "1f1b"
+    pp_micro: int = 0
     # Activation rematerialization in the train step: bool (True == "full")
     # or a named policy ("none"/"full"/"dots"/"names"/"offload" — see
     # parallel/remat.py). Unset falls back to the GRAFT_REMAT env knob.

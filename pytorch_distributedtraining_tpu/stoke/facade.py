@@ -71,6 +71,17 @@ def _remat_from_env(configured):
     return resolve_remat(env)
 
 
+def _pp_from_env(cfg):
+    """Resolve the pipeline knobs: ``$GRAFT_PP`` / ``$GRAFT_PP_SCHEDULE`` /
+    ``$GRAFT_PP_MICRO`` override the TPUConfig fields (deploy-time twins,
+    same pattern as GRAFT_REMAT). Returns ``(pp, schedule, n_micro)``;
+    schedule spelling is validated at PipelineStep construction."""
+    pp = int(os.environ.get("GRAFT_PP", cfg.pp or 1))
+    schedule = os.environ.get("GRAFT_PP_SCHEDULE", cfg.pp_schedule or "1f1b")
+    n_micro = int(os.environ.get("GRAFT_PP_MICRO", cfg.pp_micro or 0))
+    return pp, schedule, n_micro
+
+
 def _apply_scan_layers_env(model):
     """``GRAFT_SCAN_LAYERS=1|0`` flips a model's ``scan_layers`` flag.
 
@@ -449,15 +460,31 @@ class Stoke:
             offload_params=offload_par,
         )
         zero = fairscale_oss or fairscale_sddp or fairscale_fsdp
+        self.pp, self.pp_schedule, self.pp_micro = _pp_from_env(self.tpu_config)
         if mesh is not None:
             self.mesh = mesh
-        elif self.tpu_config.dp or self.tpu_config.fsdp > 1 or self.tpu_config.tp > 1:
+            self.pp = self.mesh.shape.get("pp", 1)
+        elif (
+            self.tpu_config.dp
+            or self.tpu_config.fsdp > 1
+            or self.tpu_config.tp > 1
+            or self.pp > 1
+        ):
+            dp = self.tpu_config.dp
+            if dp is None and self.pp > 1:
+                # $GRAFT_PP alone: remaining devices go to the data axis
+                used = (
+                    self.tpu_config.fsdp * self.tpu_config.tp
+                    * self.tpu_config.sp * self.pp
+                )
+                dp = max(1, jax.device_count() // used)
             self.mesh = make_mesh(
                 MeshSpec(
-                    dp=self.tpu_config.dp or 1,
+                    dp=dp or 1,
                     fsdp=self.tpu_config.fsdp,
                     tp=self.tpu_config.tp,
                     sp=self.tpu_config.sp,
+                    pp=self.pp,
                 )
             )
         else:
@@ -617,6 +644,19 @@ class Stoke:
                 f"precision={self.fp16 or 'fp32'}, accum={self.grad_accum_steps}"
             )
         return self
+
+    @property
+    def state(self):
+        """The facade's TrainState (shared by eager/fused/pipelined paths).
+
+        Assignable so an external engine (``pipeline_step``) can hand an
+        updated state back: ``stoke.state, m = pstep(stoke.state, batch)``.
+        """
+        return self._state
+
+    @state.setter
+    def state(self, new_state):
+        self._state = new_state
 
     def _update_wire_dtype(self):
         """Fairscale OSS ``broadcast_fp16`` twin (`Stoke-DDP.py:197-199`):
@@ -1163,6 +1203,57 @@ class Stoke:
         )
         self._note_loss(metrics["loss"])
         return metrics
+
+    def pipeline_step(
+        self,
+        block_fn,
+        head_fn,
+        *,
+        embed_fn=None,
+        stages_key: str = "h",
+        n_micro: int | None = None,
+        schedule: str | None = None,
+        v: int = 1,
+    ):
+        """Build a :class:`~..parallel.pipeline.PipelineStep` on the
+        facade's mesh/optimizer/policy (the ``$GRAFT_PP`` family sizes the
+        mesh and supplies schedule/n_micro defaults).
+
+        The pipelined loss is DECOMPOSED — ``embed_fn``/``block_fn``/
+        ``head_fn`` as documented on PipelineStep — because the engine
+        places it around the pipe; the facade's monolithic ``loss``
+        callable cannot be split automatically. Re-homes the facade
+        state's stacked ``stages_key`` leaves onto the pp axis (state is
+        shared with the eager surface). Call after ``init(...)``.
+        """
+        if self._state is None:
+            raise RuntimeError(
+                "pipeline_step needs initialized state — call "
+                "stoke.init(sample_input) (or run a forward) first"
+            )
+        from ..parallel.pipeline import PipelineStep, pipeline_state_shardings
+
+        self._shardings = pipeline_state_shardings(
+            self._shardings, self._state, self.mesh, stages_key
+        )
+        self._state = jax.device_put(self._state, self._shardings)
+        n_micro = n_micro or self.pp_micro or max(
+            self.grad_accum_steps, 2 * max(self.pp, 1)
+        )
+        return PipelineStep(
+            block_fn,
+            self._tx,
+            self.mesh,
+            self.policy,
+            n_micro=n_micro,
+            schedule=schedule or self.pp_schedule,
+            v=v,
+            stages_key=stages_key,
+            embed_fn=embed_fn,
+            head_fn=head_fn,
+            state_shardings=self._shardings,
+            donate=self.tpu_config.donate_state,
+        )
 
     # -- data --------------------------------------------------------------
 

@@ -1,4 +1,4 @@
-"""GPipe pipeline parallelism: numerics vs sequential stage execution."""
+"""Pipeline engine: schedule tables, numerics vs sequential execution."""
 
 import jax
 import jax.numpy as jnp
@@ -7,11 +7,15 @@ import pytest
 
 from pytorch_distributedtraining_tpu.models.gpt2 import Block, GPT2Config
 from pytorch_distributedtraining_tpu.parallel.pipeline import (
+    build_schedule,
     pipeline_apply,
+    pipeline_value_and_grad,
     stack_stage_params,
     unstack_stage_params,
 )
-from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
+from pytorch_distributedtraining_tpu.runtime.mesh import (
+    MeshSpec, batch_spec, data_axes, make_mesh,
+)
 
 CFG = GPT2Config.tiny(n_embd=16, n_head=2)
 N_STAGES, B, T = 4, 8, 16
@@ -49,7 +53,7 @@ def test_pipeline_matches_sequential(stages, x, devices8, n_micro):
     stacked, stage_fn = stages
     ref = _sequential(stacked, x, stage_fn)
     mesh = make_mesh(MeshSpec(dp=2, pp=4), devices=devices8)
-    with jax.set_mesh(mesh):
+    with mesh:
         out = jax.jit(
             lambda p, a: pipeline_apply(
                 p, a, stage_fn=stage_fn, mesh=mesh, n_micro=n_micro
@@ -70,7 +74,7 @@ def test_pipeline_gradients_match(stages, x, devices8):
         return jnp.mean(_sequential(p, x, stage_fn) ** 2)
 
     g_ref = jax.grad(loss_ref)(stacked)
-    with jax.set_mesh(mesh):
+    with mesh:
         g_pp = jax.jit(jax.grad(loss_pp))(stacked)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
@@ -94,3 +98,232 @@ def test_indivisible_microbatch_raises(stages, x, devices8):
     mesh = make_mesh(MeshSpec(pp=4), devices=devices8[:4])
     with pytest.raises(ValueError, match="not divisible"):
         pipeline_apply(stacked, x, stage_fn=stage_fn, mesh=mesh, n_micro=3)
+
+
+# ---------------------------------------------------------------------------
+# schedule tables: pinned tick/residency/hop counts per (name, N, M, v)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name,n,m,v,ticks,res,perm",
+    [
+        ("gpipe", 2, 4, 1, 10, 4, 2),
+        ("1f1b", 2, 4, 1, 10, 2, 7),
+        ("gpipe", 4, 8, 1, 22, 8, 2),
+        ("1f1b", 4, 8, 1, 22, 4, 5),
+        ("interleaved", 2, 4, 2, 18, 5, 10),
+        ("interleaved", 4, 8, 2, 38, 11, 7),
+    ],
+)
+def test_schedule_table_pinned(name, n, m, v, ticks, res, perm):
+    s = build_schedule(name, n, m, v=v)
+    assert s.n_ticks == ticks
+    assert s.res_slots == res
+    assert s.expected_collective_permutes == perm
+    for key in ("kind", "micro", "chunk", "res_slot", "in_slot"):
+        assert s.tables[key].shape == (n, ticks)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+@pytest.mark.parametrize("mult", [1, 2, 3])
+def test_1f1b_residency_bounded_by_stages(n, mult):
+    """The tentpole memory claim: 1F1B holds O(N) residuals where GPipe
+    holds O(M) — every microbatch's backward drains before the next fills
+    its slot."""
+    m = mult * n
+    assert build_schedule("1f1b", n, m).res_slots == n
+    assert build_schedule("gpipe", n, m).res_slots == m
+
+
+@pytest.mark.parametrize("name", ["gpipe", "1f1b"])
+def test_bubble_fraction_analytic(name):
+    # both fill-drain schedules idle (N-1)/(M+N-1) of the ticks
+    for n, m in [(2, 4), (4, 8), (4, 12)]:
+        s = build_schedule(name, n, m)
+        assert s.bubble_fraction == pytest.approx((n - 1) / (m + n - 1))
+
+
+def test_interleaved_shrinks_bubble():
+    flat = build_schedule("1f1b", 4, 8)
+    inter = build_schedule("interleaved", 4, 8, v=2)
+    assert inter.bubble_fraction < flat.bubble_fraction
+
+
+def test_schedule_errors():
+    with pytest.raises(ValueError, match="divisible"):
+        build_schedule("interleaved", 4, 6, v=2)
+    with pytest.raises(ValueError, match="n_micro"):
+        build_schedule("1f1b", 4, 0)
+    with pytest.raises(ValueError):
+        build_schedule("zigzag", 4, 8)
+
+
+# ---------------------------------------------------------------------------
+# pipeline_value_and_grad: loss+grads vs an explicitly microbatched loop
+# ---------------------------------------------------------------------------
+
+D, L, PB, M = 8, 4, 8, 4
+
+
+def _mlp_block(p_layer, x):
+    return jnp.tanh(x @ p_layer["w"] + p_layer["b"])
+
+
+def _mlp_embed(other, mb, rng):
+    return mb["x"] @ other["emb"]
+
+
+def _mlp_head(other, y, mb, rng):
+    return jnp.mean((y @ other["out"] - mb["y"]) ** 2)
+
+
+@pytest.fixture(scope="module")
+def mlp_params():
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(0), 4)
+    return {
+        "h": {
+            "w": jax.random.normal(k1, (L, D, D)) * 0.3,
+            "b": jax.random.normal(k2, (L, D)) * 0.1,
+        },
+        "emb": jax.random.normal(k3, (D, D)) * 0.3,
+        "out": jax.random.normal(k4, (D, 1)) * 0.3,
+    }
+
+
+@pytest.fixture(scope="module")
+def mlp_batch():
+    return {
+        "x": jax.random.normal(jax.random.PRNGKey(5), (PB, D)),
+        "y": jax.random.normal(jax.random.PRNGKey(9), (PB, 1)),
+    }
+
+
+def _mlp_ref_loss(params, batch, rng):
+    other = {k: p for k, p in params.items() if k != "h"}
+    micro = jax.tree.map(
+        lambda a: a.reshape(M, a.shape[0] // M, *a.shape[1:]), batch
+    )
+    total = 0.0
+    for mu in range(M):
+        mb = jax.tree.map(lambda a: a[mu], micro)
+        x = _mlp_embed(other, mb, jax.random.fold_in(rng, mu))
+        for i in range(L):
+            x = _mlp_block(jax.tree.map(lambda a: a[i], params["h"]), x)
+        total = total + _mlp_head(other, x, mb, jax.random.fold_in(rng, mu))
+    return total / M
+
+
+@pytest.mark.parametrize(
+    "schedule,v,spec",
+    [
+        ("gpipe", 1, MeshSpec(pp=4)),
+        ("1f1b", 1, MeshSpec(pp=4)),
+        ("interleaved", 2, MeshSpec(pp=2)),
+        ("1f1b", 1, MeshSpec(dp=2, pp=4)),
+    ],
+)
+def test_engine_matches_microbatched_loop(
+    mlp_params, mlp_batch, devices8, schedule, v, spec
+):
+    rng = jax.random.PRNGKey(3)
+    l_ref, g_ref = jax.value_and_grad(_mlp_ref_loss)(
+        mlp_params, mlp_batch, rng
+    )
+    mesh = make_mesh(spec, devices=devices8[:spec.size])
+    sched = build_schedule(schedule, spec.pp, M, v=v)
+    loss, grads = pipeline_value_and_grad(
+        mlp_params, mlp_batch, rng, mesh=mesh, schedule=sched,
+        block_fn=_mlp_block, stages_key="h",
+        embed_fn=_mlp_embed, head_fn=_mlp_head,
+    )
+    assert float(loss) == pytest.approx(float(l_ref), abs=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=5e-6
+        ),
+        g_ref,
+        grads,
+    )
+
+
+def test_engine_missing_stages_key_raises(mlp_params, mlp_batch, devices8):
+    mesh = make_mesh(MeshSpec(pp=4), devices=devices8[:4])
+    bad = {k: p for k, p in mlp_params.items() if k != "h"}
+    with pytest.raises(ValueError, match="stacked tree"):
+        pipeline_value_and_grad(
+            bad, mlp_batch, jax.random.PRNGKey(0), mesh=mesh,
+            schedule=build_schedule("1f1b", 4, M),
+            block_fn=_mlp_block, stages_key="h",
+            embed_fn=_mlp_embed, head_fn=_mlp_head,
+        )
+
+
+def test_engine_layer_chunk_mismatch_raises(mlp_params, mlp_batch, devices8):
+    mesh = make_mesh(MeshSpec(pp=4), devices=devices8[:4])
+    with pytest.raises(ValueError, match="virtual chunks"):
+        pipeline_value_and_grad(
+            mlp_params, mlp_batch, jax.random.PRNGKey(0), mesh=mesh,
+            schedule=build_schedule("interleaved", 4, 8, v=2),  # wants 8 | L
+            block_fn=_mlp_block, stages_key="h",
+            embed_fn=_mlp_embed, head_fn=_mlp_head,
+        )
+
+
+# ---------------------------------------------------------------------------
+# mesh plumbing the engine leans on
+# ---------------------------------------------------------------------------
+
+
+def test_pure_pp_mesh_has_no_data_axes(devices8):
+    # a raw mesh with ONLY a pp axis (make_mesh would keep size-1 dp/fsdp
+    # named): batch_spec must yield a replicated spec, not crash on a
+    # missing data axis
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(devices8[:4]).reshape(4), ("pp",))
+    assert data_axes(mesh) == ()
+    spec = batch_spec(mesh)
+    # replicated batch dim (P(()) and P() are the same placement)
+    assert not spec or spec[0] in ((), None)
+
+
+def test_dp_pp_mesh_keeps_data_axes(devices8):
+    mesh = make_mesh(MeshSpec(dp=2, pp=4), devices=devices8)
+    assert "dp" in data_axes(mesh)
+
+
+def test_pipeline_state_shardings_rehomes_stage_leaves(devices8):
+    from jax.sharding import PartitionSpec as P
+
+    from pytorch_distributedtraining_tpu import optim
+    from pytorch_distributedtraining_tpu.parallel import (
+        Policy, create_train_state, pipeline_state_shardings,
+    )
+
+    mesh = make_mesh(MeshSpec(pp=4), devices=devices8[:4])
+
+    def init_fn(rng):
+        return {
+            "h": {"w": jnp.zeros((L, D, D)), "b": jnp.zeros((L, D))},
+            "out": jnp.zeros((D, 1)),
+        }, {}
+
+    state, shardings = create_train_state(
+        init_fn=init_fn, tx=optim.adamw(lr=1e-3), mesh=mesh, policy=Policy()
+    )
+    re = pipeline_state_shardings(shardings, state, mesh, "h")
+    assert re.params["h"]["w"].spec == P("pp")
+    assert re.params["h"]["b"].spec == P("pp")
+    # non-stage leaves keep their policy layout (replicated here)
+    assert re.params["out"].spec == P()
+    # the optimizer's stage moments ride the same pp placement: adamw's
+    # mu and nu each mirror the two stacked "h" leaves
+    opt_specs = [
+        s.spec
+        for s in jax.tree.leaves(
+            re.opt_state, is_leaf=lambda s: hasattr(s, "spec")
+        )
+        if hasattr(s, "spec")
+    ]
+    assert opt_specs.count(P("pp")) >= 4

@@ -1,0 +1,120 @@
+"""pipeline_audit: the compiled wire plan must match the schedule table.
+
+Each schedule's cross-stage hop count is a fingerprint of the compiled
+module (GPipe fuses every fwd/bwd hop into one permute per direction;
+1F1B's interleaving forces per-segment permutes). The audit counts
+collective-permute instructions in the HLO and classifies them
+fwd/bwd by their source_target_pairs, so a step compiled under the
+wrong schedule — or a regression that re-fuses/duplicates channels —
+is caught before any timing run is trusted.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from pytorch_distributedtraining_tpu.observe import pipeline_audit
+from pytorch_distributedtraining_tpu.parallel import (
+    PipelineStep,
+    Policy,
+    build_schedule,
+    create_train_state,
+    pipeline_state_shardings,
+)
+
+D, L, B, M = 8, 4, 8, 4
+
+
+def _compiled_text(devices, schedule, pp, v=1):
+    mesh = Mesh(np.array(devices[:pp]).reshape(pp), ("pp",))
+
+    def init_fn(rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "h": {
+                "w": jax.random.normal(k1, (L, D, D)) * 0.3,
+                "b": jnp.zeros((L, D)),
+            },
+            "out": jax.random.normal(k2, (D, 1)) * 0.3,
+        }, {}
+
+    tx = optax.sgd(1e-2)
+    state, sh = create_train_state(
+        init_fn=init_fn, tx=tx, mesh=mesh, policy=Policy()
+    )
+    sh = pipeline_state_shardings(sh, state, mesh, "h")
+    state = jax.device_put(state, sh)
+    step = PipelineStep(
+        lambda p, x: jnp.tanh(x @ p["w"] + p["b"]),
+        tx, mesh, Policy(), n_micro=M, schedule=schedule, v=v,
+        stages_key="h",
+        embed_fn=lambda o, mb, rng: mb,
+        head_fn=lambda o, y, mb, rng: jnp.mean((y @ o["out"]) ** 2),
+        state_shardings=sh, donate=False,
+    )
+    batch = jnp.zeros((B, D), jnp.float32)
+    return step.compiled_text(state, batch), step.schedule, mesh
+
+
+@pytest.fixture(scope="module")
+def hlo_1f1b(devices8):
+    return _compiled_text(devices8, "1f1b", 4)
+
+
+@pytest.fixture(scope="module")
+def hlo_gpipe(devices8):
+    return _compiled_text(devices8, "gpipe", 4)
+
+
+def test_audit_accepts_matching_schedule(hlo_1f1b, hlo_gpipe):
+    for text, sched, mesh in (hlo_1f1b, hlo_gpipe):
+        audit = pipeline_audit(text, sched, mesh=mesh)
+        assert audit.ok, audit
+        assert audit.found_permutes == sched.expected_collective_permutes
+        assert audit.count_ok and audit.pairs_ok
+
+
+def test_audit_classifies_channels(hlo_1f1b):
+    text, sched, mesh = hlo_1f1b
+    audit = pipeline_audit(text, sched, mesh=mesh)
+    # 1f1b n=4 m=4: fwd and bwd rings are distinct device-pair sets, so
+    # every instruction lands in exactly one direction bucket
+    assert audit.fwd_instructions + audit.bwd_instructions == (
+        audit.found_permutes
+    )
+    assert not audit.unmatched
+
+
+def test_audit_rejects_gpipe_step_against_1f1b_table(hlo_gpipe, devices8):
+    """Satellite guard: a compiled GPipe step handed to tooling that
+    expects 1F1B must fail the audit, not silently pass timing."""
+    text, _, mesh = hlo_gpipe
+    expect_1f1b = build_schedule("1f1b", 4, M)
+    audit = pipeline_audit(text, expect_1f1b, mesh=mesh)
+    assert not audit.ok
+    assert audit.found_permutes != expect_1f1b.expected_collective_permutes
+
+
+def test_audit_rejects_1f1b_step_against_gpipe_table(hlo_1f1b, devices8):
+    text, _, mesh = hlo_1f1b
+    expect_gpipe = build_schedule("gpipe", 4, M)
+    audit = pipeline_audit(text, expect_gpipe, mesh=mesh)
+    assert not audit.ok
+
+
+def test_audit_interleaved(devices8):
+    text, sched, mesh = _compiled_text(devices8, "interleaved", 2, v=2)
+    audit = pipeline_audit(text, sched, mesh=mesh)
+    assert audit.ok, audit
+
+
+def test_audit_counts_without_mesh(hlo_1f1b):
+    # no mesh -> count-only mode: pair classification is vacuously ok
+    text, sched, _ = hlo_1f1b
+    audit = pipeline_audit(text, sched)
+    assert audit.count_ok
+    assert audit.fwd_instructions < 0  # sentinel: pairs not checked
+    assert audit.ok

@@ -539,3 +539,49 @@ def test_oss_broadcast_fp16_narrows_update_wire():
                         jax.tree.leaves(s_off.state.params))
     )
     assert close and not identical
+
+
+# -- pipeline knobs ($GRAFT_PP family) ------------------------------------
+
+
+def test_pp_env_knobs_resolution(monkeypatch):
+    from pytorch_distributedtraining_tpu.stoke.config import TPUConfig
+    from pytorch_distributedtraining_tpu.stoke.facade import _pp_from_env
+
+    for var in ("GRAFT_PP", "GRAFT_PP_SCHEDULE", "GRAFT_PP_MICRO"):
+        monkeypatch.delenv(var, raising=False)
+    assert _pp_from_env(TPUConfig()) == (1, "1f1b", 0)
+    assert _pp_from_env(
+        TPUConfig(pp=2, pp_schedule="interleaved", pp_micro=6)
+    ) == (2, "interleaved", 6)
+    # env twins override the config fields (deploy-time, like GRAFT_REMAT)
+    monkeypatch.setenv("GRAFT_PP", "4")
+    monkeypatch.setenv("GRAFT_PP_SCHEDULE", "gpipe")
+    monkeypatch.setenv("GRAFT_PP_MICRO", "8")
+    assert _pp_from_env(TPUConfig(pp=2)) == (4, "gpipe", 8)
+
+
+def test_pp_env_shapes_facade_mesh(monkeypatch):
+    monkeypatch.setenv("GRAFT_PP", "2")
+    monkeypatch.delenv("GRAFT_PP_SCHEDULE", raising=False)
+    s = _stoke()
+    # $GRAFT_PP alone: remaining devices fill the data axis
+    assert s.mesh.shape["pp"] == 2
+    assert s.mesh.shape["dp"] == jax.device_count() // 2
+    assert s.pp == 2 and s.pp_schedule == "1f1b"
+
+
+def test_explicit_mesh_overrides_pp_env(monkeypatch, mesh8):
+    monkeypatch.setenv("GRAFT_PP", "4")
+    s = _stoke(mesh=mesh8)
+    # a caller-supplied mesh wins; pp reflects ITS shape, not the env
+    assert s.pp == mesh8.shape.get("pp", 1) == 1
+
+
+def test_pipeline_step_requires_initialized_state(monkeypatch):
+    monkeypatch.setenv("GRAFT_PP", "2")
+    s = _stoke()
+    with pytest.raises(RuntimeError, match="init"):
+        s.pipeline_step(
+            lambda p, x: x, lambda o, y, mb, rng: jnp.mean(y**2)
+        )
