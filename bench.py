@@ -1084,6 +1084,11 @@ def _bench() -> None:
                 for _ in range(3):
                     state, metrics = step(state, batch)
                 jax.block_until_ready(metrics["loss"])
+        # fixed-shape window starts here: any compile-cache entry that
+        # appears between this snapshot and the end of the timed windows
+        # is a mid-measurement retrace (graftcheck's recompile-drift rule
+        # gates on the pair below)
+        cache_entries_warm = cache_entry_count(cache_path)
         print("# child: warmup done, timing", flush=True)
         # Best-of-N sustained windows: the shared pool's tunnel congestion
         # varies at the seconds scale (same committed config measured 12079
@@ -1267,6 +1272,53 @@ def _bench() -> None:
         best = rates.index(img_per_sec)
         f = overlap_fracs[best]
         overlap_fraction = None if f is None else round(f, 4)
+    # graftcheck (untimed; must run BEFORE the accounting passes below —
+    # memory_analysis/pipeline probe legitimately add cache entries, so
+    # the recompile-drift window closes here): trace+HLO rules over the
+    # timed step, plus the cache-entry pair bracketing the fixed-shape
+    # windows. Error-severity findings refuse to publish (exit 7): a
+    # record whose timing includes recompiles, or whose step hides a
+    # host round-trip, is not a benchmark result. GRAFT_BENCH_ANALYZE=0
+    # opts out; analyzer *crashes* (not findings) degrade to
+    # static_findings=None rather than killing the run.
+    static_findings = None
+    if os.environ.get("GRAFT_BENCH_ANALYZE", "1").strip().lower() not in (
+        "0", "false", "off", "no"
+    ):
+        try:
+            entries_after_windows = cache_entry_count(cache_path)
+            from pytorch_distributedtraining_tpu.analyze import analyze_step
+
+            report = analyze_step(
+                step,
+                state,
+                batch,
+                cache_entries_before=cache_entries_warm,
+                cache_entries_after=entries_after_windows,
+                cache_window=(
+                    f"{len(rates)} timed windows x {actual_steps} "
+                    "fixed-shape steps"
+                ),
+            )
+            for line in report.render().splitlines():
+                print(f"# child: {line}", flush=True)
+            static_findings = report.counts()
+            if not report.ok:
+                # no "# " prefix: _informative_tail must pick THIS line
+                # as the cause in the parent's error record
+                print(
+                    "STATIC ANALYSIS ERRORS: "
+                    + "; ".join(
+                        f"{f.rule}: {f.message}" for f in report.errors
+                    )[:400]
+                    + " — refusing to publish",
+                    flush=True,
+                )
+                sys.exit(7)
+        except SystemExit:
+            raise
+        except Exception as e:  # noqa: BLE001 — analyzer crash != finding
+            print(f"# child: graftcheck unavailable: {e}", flush=True)
     # HBM accounting (untimed, after the windows): XLA's memory plan for
     # the compiled step — the persistent compile cache makes this AOT
     # lower+compile a cheap deserialize, not a second cold compile. None
@@ -1349,6 +1401,7 @@ def _bench() -> None:
                 ),
                 "overlap_fraction": overlap_fraction,
                 "compile_cache": compile_cache,
+                "static_findings": static_findings,
                 "peak_hbm_bytes": peak_hbm_bytes,
                 "remat": remat_impl,
                 "scan_layers": scan_layers,

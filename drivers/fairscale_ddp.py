@@ -148,6 +148,20 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
         loss_fn, tx, mesh, ZeRO2(remat=remat), state_shardings=shardings
     )
 
+    # --analyze/$GRAFT_ANALYZE: graftcheck the step before the first
+    # device step (AOT — the jit cache keeps the lowering, so the
+    # training loop below pays no extra compile)
+    analyze = getattr(opt, "analyze", None) or os.environ.get("GRAFT_ANALYZE")
+    if analyze and analyze != "off":
+        from pytorch_distributedtraining_tpu.analyze import analyze_step
+
+        report = analyze_step(step, state, (x, y))
+        print(report.render())
+        if analyze == "error" and not report.ok:
+            print("===> graftcheck: error-severity findings; aborting "
+                  "before the first step")
+            raise SystemExit(2)
+
     loss = None
     for e in range(epochs):
         for iteration, batch in enumerate(training_dataloader, 1):
@@ -191,6 +205,14 @@ def main(argv=None):
                         help="pipeline schedule (env twin "
                              "$GRAFT_PP_SCHEDULE); recorded for tooling "
                              "parity with bench.py")
+    parser.add_argument("--analyze", type=str, nargs="?", const="error",
+                        default=os.environ.get("GRAFT_ANALYZE"),
+                        choices=["warn", "error", "off"],
+                        help="run graftcheck static analysis on the step "
+                             "before training: warn prints the report, "
+                             "error additionally aborts on error-severity "
+                             "findings (bare --analyze = error; env twin "
+                             "$GRAFT_ANALYZE)")
     opt = parser.parse_args(argv)
 
     # GRAFT_PLATFORM=cpu forces the backend (see runtime.dist docstring:

@@ -73,6 +73,22 @@ def val_log(loss, avg_mae, avg_psnr, example_ct, epoch):
     )
 
 
+def _maybe_analyze(stoke_model: Stoke, inputs, targets):
+    """--analyze/$GRAFT_ANALYZE: graftcheck the fused-step program on the
+    first batch. ``warn`` prints the report; ``error`` aborts on
+    error-severity findings before any device step runs."""
+    mode = getattr(opt, "analyze", None) if "opt" in globals() else None
+    mode = mode or os.environ.get("GRAFT_ANALYZE")
+    if not mode or mode == "off":
+        return
+    report = stoke_model.static_analyze(inputs, targets)
+    print(report.render())
+    if mode == "error" and not report.ok:
+        print("===> graftcheck: error-severity findings; aborting before "
+              "the first step")
+        raise SystemExit(2)
+
+
 def train(train_dataloader, stoke_model: Stoke, scheduler1, scheduler2, epoch: int):
     example_ct = 0
     batch_ct = 0
@@ -82,6 +98,12 @@ def train(train_dataloader, stoke_model: Stoke, scheduler1, scheduler2, epoch: i
     stoke_model.model_access.train()
 
     for idx, (inputs, targets) in enumerate(train_dataloader):
+        if epoch == 0 and idx == 0:
+            # graftcheck before the first device step. This driver trains
+            # on the eager loss/backward/step surface, which never builds
+            # the fused TrainStep on its own — analyze it explicitly so
+            # --analyze means the same thing on every driver.
+            _maybe_analyze(stoke_model, inputs, targets)
         outputs = stoke_model.model(inputs)
         train_loss = stoke_model.loss(outputs, targets)
 
@@ -199,6 +221,14 @@ def build_parser():
                         choices=["gpipe", "1f1b", "interleaved"],
                         help="pipeline schedule for pipelined steps (env "
                              "twin $GRAFT_PP_SCHEDULE)")
+    parser.add_argument("--analyze", type=str, nargs="?", const="error",
+                        default=os.environ.get("GRAFT_ANALYZE"),
+                        choices=["warn", "error", "off"],
+                        help="run graftcheck static analysis at first "
+                             "compile of the fused step: warn prints the "
+                             "report, error additionally aborts on "
+                             "error-severity findings (bare --analyze = "
+                             "error; env twin $GRAFT_ANALYZE)")
     return parser
 
 
@@ -254,6 +284,12 @@ def main(argv=None):
         os.environ["GRAFT_PP_SCHEDULE"] = opt.pp_schedule
         print(f"===> pp={opt.pp} schedule={opt.pp_schedule} "
               "(mesh axis only on this driver; see --help)")
+
+    # --analyze threads graftcheck through its env twin: the facade runs
+    # the analyzer once at first compile of the fused step
+    if opt.analyze:
+        os.environ["GRAFT_ANALYZE"] = opt.analyze
+        print(f"===> graftcheck analyze={opt.analyze}")
 
     optimizer = StokeOptimizer(
         optimizer="AdamW",

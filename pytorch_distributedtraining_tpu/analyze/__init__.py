@@ -1,0 +1,53 @@
+"""graftcheck: trace-time + HLO static analysis for TPU train steps.
+
+Catches the hazards that otherwise only surface as pod-slot burn at
+step 1 — silent recompiles, donation conflicts, host round-trips,
+replicated-when-sharded params — by inspecting the abstract-evaluated
+jaxpr and the AOT-compiled HLO *before* the first device step.
+
+Entry points::
+
+    from pytorch_distributedtraining_tpu.analyze import analyze_step
+    report = analyze_step(step, state, batch)
+    print(report.render()); assert report.ok
+
+    python -m pytorch_distributedtraining_tpu.analyze --model mlp \
+        --mesh dp2,fsdp2 --policy zero2   # AOT on CPU, exit 1 on errors
+
+Env: ``GRAFT_ANALYZE=off|warn|error`` gates the facade hook;
+``GRAFT_ANALYZE_IGNORE=rule,rule`` suppresses named rules (they still
+show in the report's suppressed section). Rule catalog and severities:
+docs/STATIC_ANALYSIS.md.
+"""
+
+from .findings import (
+    ENV_IGNORE,
+    ENV_MODE,
+    Finding,
+    Report,
+    Severity,
+    analyze_mode,
+    ignored_rules,
+)
+from .registry import PLANES, RULES, AnalysisContext, Rule, rule, run_rules
+from .runner import analyze_step, build_context, rule_catalog, step_jaxpr
+
+__all__ = [
+    "Finding",
+    "Report",
+    "Severity",
+    "ENV_MODE",
+    "ENV_IGNORE",
+    "analyze_mode",
+    "ignored_rules",
+    "AnalysisContext",
+    "Rule",
+    "rule",
+    "run_rules",
+    "RULES",
+    "PLANES",
+    "analyze_step",
+    "build_context",
+    "step_jaxpr",
+    "rule_catalog",
+]

@@ -1,0 +1,154 @@
+"""Finding/severity model shared by every graftcheck rule.
+
+One flat vocabulary for "the analyzer saw something": a
+:class:`Finding` names the rule, a severity, a location string
+("jaxpr", "hlo", "runtime", or something finer like
+"hlo:%all-reduce.2"), a human message, and optional evidence (the
+offending HLO line, the constant's shape, ...). A :class:`Report` is
+what every entry point — CLI, facade, drivers, bench — renders and
+gates on.
+
+Env contract (mirrors the GRAFT_* knob family in stoke/facade.py):
+
+- ``GRAFT_ANALYZE`` = ``off`` (default) | ``warn`` | ``error`` — whether
+  the facade runs the analyzer at first compile, and whether error
+  findings raise or just print.
+- ``GRAFT_ANALYZE_IGNORE`` = comma-separated rule names to suppress.
+  Suppressed findings still appear in ``Report.suppressed`` so a report
+  never silently shrinks.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from dataclasses import dataclass, field
+
+ENV_MODE = "GRAFT_ANALYZE"
+ENV_IGNORE = "GRAFT_ANALYZE_IGNORE"
+
+_MODES = ("off", "warn", "error")
+
+
+class Severity(enum.IntEnum):
+    """Ordered so `max(f.severity for f in findings)` is the verdict."""
+
+    INFO = 0
+    WARN = 1
+    ERROR = 2
+
+    def __str__(self) -> str:  # render as "error", not "Severity.ERROR"
+        return self.name.lower()
+
+    @classmethod
+    def parse(cls, s: str) -> "Severity":
+        try:
+            return cls[s.strip().upper()]
+        except KeyError:
+            raise ValueError(
+                f"unknown severity {s!r}; expected one of "
+                f"{[m.name.lower() for m in cls]}"
+            ) from None
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One observation from one rule.
+
+    ``loc`` is the inspection plane plus an optional anchor
+    (``"hlo:%all-reduce.2"``); ``evidence`` carries the raw artifact
+    (HLO line, jaxpr primitive, byte count) so a report is actionable
+    without re-running the analyzer.
+    """
+
+    rule: str
+    severity: Severity
+    loc: str
+    message: str
+    evidence: str = ""
+
+    def render(self) -> str:
+        line = f"[{self.severity}] {self.rule} @ {self.loc}: {self.message}"
+        if self.evidence:
+            line += f"\n        evidence: {self.evidence}"
+        return line
+
+
+@dataclass
+class Report:
+    """All findings from one analyzer run, plus what was suppressed.
+
+    Suppression (via ``GRAFT_ANALYZE_IGNORE`` or an explicit ignore set)
+    moves findings to ``suppressed`` rather than dropping them — the
+    rendered report still shows they existed.
+    """
+
+    findings: list = field(default_factory=list)
+    suppressed: list = field(default_factory=list)
+    rules_run: tuple = ()
+
+    @property
+    def errors(self) -> list:
+        return [f for f in self.findings if f.severity is Severity.ERROR]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.errors else 0
+
+    def counts(self) -> dict:
+        """{"error": n, "warn": n, "info": n} — the bench-record shape."""
+        out = {"error": 0, "warn": 0, "info": 0}
+        for f in self.findings:
+            out[str(f.severity)] += 1
+        return out
+
+    def by_rule(self, rule: str) -> list:
+        return [f for f in self.findings if f.rule == rule]
+
+    def render(self) -> str:
+        lines = [
+            f"graftcheck: {len(self.rules_run)} rules, "
+            f"{len(self.findings)} findings "
+            f"({self.counts()['error']} error, {self.counts()['warn']} warn, "
+            f"{self.counts()['info']} info)"
+        ]
+        order = sorted(
+            self.findings, key=lambda f: (-int(f.severity), f.rule)
+        )
+        lines += [f.render() for f in order]
+        if self.suppressed:
+            sup = sorted({f.rule for f in self.suppressed})
+            lines.append(
+                f"suppressed via {ENV_IGNORE}: "
+                + ", ".join(
+                    f"{r} ({sum(1 for f in self.suppressed if f.rule == r)})"
+                    for r in sup
+                )
+            )
+        if not self.findings and not self.suppressed:
+            lines.append("clean: no findings")
+        return "\n".join(lines)
+
+
+def analyze_mode(env: dict | None = None) -> str:
+    """Resolve GRAFT_ANALYZE to off|warn|error (default off)."""
+    raw = (env or os.environ).get(ENV_MODE, "off").strip().lower()
+    if raw in ("", "0", "false", "no", "none"):
+        return "off"
+    if raw in ("1", "true", "yes", "on"):
+        return "warn"
+    if raw not in _MODES:
+        raise ValueError(
+            f"{ENV_MODE}={raw!r}: expected one of {_MODES}"
+        )
+    return raw
+
+
+def ignored_rules(env: dict | None = None) -> frozenset:
+    """Rule names suppressed via GRAFT_ANALYZE_IGNORE (comma list)."""
+    raw = (env or os.environ).get(ENV_IGNORE, "")
+    return frozenset(p.strip() for p in raw.split(",") if p.strip())

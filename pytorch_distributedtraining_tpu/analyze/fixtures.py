@@ -1,0 +1,206 @@
+"""Seeded-violation fixtures: tiny steps that each trip exactly one rule.
+
+Each builder returns ``(step, state, batch, expected)`` where
+``expected`` is ``(rule_name, Severity)`` — or ``None`` for the clean
+fixture. They power three consumers: the CLI's ``--fixture`` flag (a
+self-demo that needs no model checkpoint), the ``__graft_entry__``
+dryrun phase (the analyzer must both pass a clean step and catch a
+seeded violation before a pod run trusts it), and the seeded-violation
+test matrix in tests/test_analyze.py.
+
+Everything runs on a 1-device mesh so fixtures work on any host,
+including a single CPU device.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import optim
+from ..parallel import DDP, TrainStep, create_train_state
+from ..runtime.mesh import MeshSpec, make_mesh
+from .findings import Severity
+
+# 8 MiB f32 constant for the giant-constant fixture — comfortably above
+# the rule's 1 MiB WARN threshold, far below its 128 MiB ERROR one
+_BIG_SHAPE = (1024, 2048)
+
+
+class TinyMLP(nn.Module):
+    """Smallest model that still exercises params/opt-state plumbing."""
+
+    features: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.features)(x)
+        x = nn.relu(x)
+        return nn.Dense(1)(x)
+
+
+def _mesh(devices=None):
+    devs = list(devices) if devices is not None else jax.devices()
+    return make_mesh(MeshSpec(dp=1), devices=devs[:1])
+
+
+def _batch():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    y = rng.normal(size=(16, 1)).astype(np.float32)
+    return (x, y)
+
+
+def _mlp_step(mesh, loss_wrap=None, policy=None, donate=True):
+    model = TinyMLP()
+    tx = optim.adamw(lr=1e-3)
+    policy = policy if policy is not None else DDP()
+
+    def loss_fn(params, batch, rng, ms):
+        x, y = batch
+        loss = jnp.mean((model.apply({"params": params}, x) - y) ** 2)
+        if loss_wrap is not None:
+            loss = loss_wrap(loss)
+        return loss, {}
+
+    state, sh = create_train_state(
+        init_fn=lambda r: (
+            model.init(r, jnp.zeros((1, 8)))["params"], {},
+        ),
+        tx=tx, mesh=mesh, policy=policy,
+    )
+    step = TrainStep(
+        loss_fn, tx, mesh, policy, state_shardings=sh, donate=donate
+    )
+    return step, state
+
+
+class _FixtureStep:
+    """Minimal TrainStep-shaped object for violations that need a body
+    TrainStep itself refuses to build (e.g. a dtype-flipping update that
+    defeats donation)."""
+
+    def __init__(self, fn, mesh, donate=True):
+        self.mesh = mesh
+        self.policy = None
+        self.donate = donate
+        self.detect_anomaly = False
+        self._step = fn
+        self._jitted = jax.jit(
+            fn, donate_argnums=(0,) if donate else ()
+        )
+
+    def compiled_text(self, state, batch, lr_factor=1.0):
+        with self.mesh:
+            with warnings.catch_warnings():
+                # the donation-conflict fixture compiles with "Some
+                # donated buffers were not usable" by design
+                warnings.simplefilter("ignore")
+                return (
+                    self._jitted.lower(state, batch, jnp.float32(lr_factor))
+                    .compile()
+                    .as_text()
+                )
+
+
+def clean(devices=None):
+    """A well-behaved MLP TrainStep: must produce zero error findings."""
+    mesh = _mesh(devices)
+    step, state = _mlp_step(mesh)
+    return step, state, _batch(), None
+
+
+def donation_conflict(devices=None):
+    """Donated state whose update flips every f32 leaf to bf16: byte
+    widths mismatch, XLA aliases nothing, donation silently copies."""
+    mesh = _mesh(devices)
+
+    def fn(state, batch, lr_factor):
+        return jax.tree.map(
+            lambda x: (
+                x.astype(jnp.bfloat16)
+                if hasattr(x, "dtype") and x.dtype == jnp.float32
+                else x
+            ),
+            state,
+        )
+
+    state = {
+        "w": jnp.ones((64, 64), jnp.float32),
+        "m": jnp.zeros((64, 64), jnp.float32),
+    }
+    step = _FixtureStep(fn, mesh, donate=True)
+    return step, state, _batch(), ("donation-unaliased", Severity.ERROR)
+
+
+def io_callback_in_loss(devices=None):
+    """The classic 'log every step from inside jit' mistake: an ordered
+    host callback on the loss, inside the jitted update. (io_callback
+    has no JVP rule, so in real code it sits just outside the grad
+    closure — exactly where this fixture puts it.)"""
+    from jax.experimental import io_callback as _io_callback
+
+    mesh = _mesh(devices)
+
+    def fn(state, batch, lr_factor):
+        x, y = batch
+
+        def loss_f(w):
+            return jnp.mean((x @ w - y) ** 2)
+
+        loss, g = jax.value_and_grad(loss_f)(state["w"])
+        logged = _io_callback(
+            lambda v: np.asarray(v, np.float32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            loss,
+            ordered=True,
+        )
+        return {"w": state["w"] - lr_factor * 1e-3 * g}, loss + 0.0 * logged
+
+    state = {"w": jnp.zeros((8, 1), jnp.float32)}
+    step = _FixtureStep(fn, mesh, donate=False)
+    return step, state, _batch(), ("host-callback", Severity.ERROR)
+
+
+def giant_constant(devices=None):
+    """Loss closes over an 8 MiB array: it compiles into the module as a
+    constant instead of arriving as an argument."""
+    mesh = _mesh(devices)
+    big = jnp.ones(_BIG_SHAPE, jnp.float32)
+
+    def wrap(loss):
+        return loss + 0.0 * big.mean()
+
+    step, state = _mlp_step(mesh, loss_wrap=wrap)
+    return step, state, _batch(), ("giant-constant", Severity.WARN)
+
+
+def untagged_remat(devices=None):
+    """remat='names' over a model with no checkpoint_name tags: the
+    policy saves nothing and silently degrades to full remat."""
+    mesh = _mesh(devices)
+    step, state = _mlp_step(mesh, policy=DDP(remat="names"))
+    return step, state, _batch(), ("remat-tag-coverage", Severity.WARN)
+
+
+FIXTURES = {
+    "clean": clean,
+    "donation-conflict": donation_conflict,
+    "io-callback": io_callback_in_loss,
+    "giant-constant": giant_constant,
+    "untagged-remat": untagged_remat,
+}
+
+
+def build_fixture(name: str, devices=None):
+    try:
+        builder = FIXTURES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown fixture {name!r}; have {sorted(FIXTURES)}"
+        ) from None
+    return builder(devices)

@@ -1,0 +1,265 @@
+"""HLO-plane rules: hazards only the compiled module can reveal.
+
+These read ``compiled.as_text()`` through the shared tokenizer in
+``observe.hlo`` — the same machinery behind the standalone audits — so
+every rule sees continuation-merged, computation-attributed
+instructions. The three pre-existing audits (overlap, pipeline, logical
+reduce-scatter) are registered here as rules sharing the severity and
+report machinery.
+"""
+
+from __future__ import annotations
+
+from ..observe.hlo import (
+    counts,
+    has_logical_reduce_scatter,
+    overlap_audit,
+    pipeline_audit,
+    tokenize_hlo,
+)
+from .findings import Finding, Severity
+from .registry import rule
+
+# sharding-backoff only audits param leaves at least this large: below
+# it, XLA's own reduce-scatter-creator legitimately declines the rewrite
+# (collective latency beats the bandwidth saved) and replicated update
+# math on a few KiB is not a hazard worth failing a run over
+BACKOFF_MIN_LEAF_ELEMS = 16384
+
+
+def _alias_entries(hlo_text: str) -> int:
+    """Count input_output_alias entries in the HloModule header."""
+    for line in hlo_text.splitlines():
+        if not line.startswith("HloModule"):
+            continue
+        if "input_output_alias={" not in line:
+            return 0
+        body = line.split("input_output_alias={", 1)[1]
+        # header attr is brace-balanced on one line; count `(operand, {...`
+        # entries rather than parsing the full grammar
+        depth, end = 1, 0
+        for i, ch in enumerate(body):
+            if ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        return body[:end].count(":")
+    return 0
+
+
+@rule(
+    "donation-unaliased",
+    "hlo",
+    "donate_argnums declared but XLA aliased no buffers",
+)
+def donation_unaliased(ctx):
+    if not ctx.hlo_text or not ctx.donate:
+        return
+    if _alias_entries(ctx.hlo_text) == 0:
+        yield Finding(
+            "donation-unaliased",
+            Severity.ERROR,
+            "hlo:module-header",
+            "the step donates its state but the compiled module has no "
+            "input_output_alias entries: every donated buffer is "
+            "silently copied, doubling state HBM. Usual cause: an "
+            "input/output dtype or sharding mismatch (e.g. params cast "
+            "to a different dtype across the update)",
+            evidence="input_output_alias absent from HloModule header",
+        )
+
+
+@rule(
+    "host-transfer",
+    "hlo",
+    "infeed/outfeed/host custom-calls in the compiled step",
+)
+def host_transfer(ctx):
+    if not ctx.hlo_text:
+        return
+    hits: dict = {}
+    for ins in tokenize_hlo(ctx.hlo_text):
+        for token in (" infeed(", " outfeed("):
+            if token in ins.text:
+                key = token.strip(" (")
+                hits.setdefault(key, []).append(ins.name)
+        if ctx.jaxpr is None and " custom-call(" in ins.text and (
+            "xla_python_cpu_callback" in ins.text
+            or "xla_ffi_python" in ins.text
+            or "callback" in ins.text.split("custom_call_target=", 1)[-1][:64]
+        ):
+            # only when no jaxpr was captured — otherwise the
+            # host-callback trace rule already reported this precisely
+            hits.setdefault("host-callback custom-call", []).append(ins.name)
+    for kind, names in sorted(hits.items()):
+        yield Finding(
+            "host-transfer",
+            Severity.WARN,
+            f"hlo:{names[0]}",
+            f"{len(names)}× {kind} in the compiled step: each one "
+            "synchronizes with the host inside the device program",
+            evidence=", ".join(names[:4]),
+        )
+
+
+@rule(
+    "sharding-backoff",
+    "hlo",
+    "params/grads declared sharded but compiled replicated",
+)
+def sharding_backoff(ctx):
+    """Generalizes the standalone ``has_logical_reduce_scatter`` audit:
+    on a mesh with >1-way data/fsdp sharding, a ZeRO-2+ policy must
+    compile to a (possibly logical) reduce-scatter, and a ZeRO-3 policy
+    must all-gather params — otherwise GSPMD backed off to replication
+    and the policy's memory savings silently evaporated.
+    """
+    if not ctx.hlo_text or ctx.mesh is None or ctx.policy is None:
+        return
+    if ctx.schedule is not None:
+        return  # pipeline layouts re-home state; audited by its own rule
+    from ..runtime.mesh import data_axes
+
+    axes = data_axes(ctx.mesh)
+    n = 1
+    for a in axes:
+        n *= ctx.mesh.shape[a]
+    if n <= 1:
+        return
+    c = counts(ctx.hlo_text)
+    if getattr(ctx.policy, "shard_grads", False) and ctx.params is not None:
+        import jax
+
+        min_shard = getattr(ctx.policy, "min_shard_size", 0)
+        sizes = {
+            x.size for x in jax.tree_util.tree_leaves(ctx.params)
+            if hasattr(x, "size")
+        }
+        divisible = sorted(
+            s for s in sizes
+            if s >= max(n, min_shard, BACKOFF_MIN_LEAF_ELEMS)
+            and s % n == 0
+        )
+        # which form the grad shard math takes varies by backend and
+        # kernel shape: literal reduce-scatter (TPU), all-to-all (one
+        # CPU rewrite), or all-reduce + shard-sized dynamic-slice (the
+        # other CPU form, any divisible leaf counts — see the
+        # test_hlo_collectives backend note)
+        sharded = (
+            c.get("reduce-scatter", 0) > 0
+            or c.get("all-to-all", 0) > 0
+            or any(
+                has_logical_reduce_scatter(ctx.hlo_text, s // n)
+                for s in divisible
+            )
+        )
+        if divisible and not sharded:
+            yield Finding(
+                "sharding-backoff",
+                Severity.ERROR,
+                "hlo",
+                f"policy shards gradients over {n} devices but the "
+                "module has no reduce-scatter in any form (literal, "
+                "all-to-all rewrite, or all-reduce+shard-slice) for any "
+                "shardable param leaf: GSPMD backed off to full "
+                "replication, so grad memory and update math run at "
+                "full size",
+                evidence=(
+                    f"shardable_leaves={divisible} "
+                    f"collectives={c}"
+                ),
+            )
+    if getattr(ctx.policy, "shard_params", False):
+        if c.get("all-gather", 0) < 1:
+            yield Finding(
+                "sharding-backoff",
+                Severity.ERROR,
+                "hlo",
+                f"policy shards parameters over {n} devices but the "
+                "module has no all-gather: compute either runs on "
+                "replicated params (no memory saved) or the constraint "
+                "was dropped",
+                evidence=f"collectives={c}",
+            )
+
+
+@rule(
+    "overlap",
+    "hlo",
+    "collectives stuck on the critical path (no async overlap)",
+)
+def overlap(ctx):
+    if not ctx.hlo_text:
+        return
+    audit = overlap_audit(ctx.hlo_text)
+    if audit.ok:
+        return
+    # XLA:CPU has no async collective scheduler, so blocking collectives
+    # there are expected and not actionable — report for visibility only
+    sev = Severity.INFO if ctx.platform == "cpu" else Severity.WARN
+    blocking = audit.blocking
+    yield Finding(
+        "overlap",
+        sev,
+        f"hlo:{blocking[0].name or blocking[0].kind}",
+        f"{len(blocking)}/{audit.total} collectives cannot overlap with "
+        "compute (synchronous form, or empty start/done window): they "
+        "serialize with the step's math",
+        evidence="; ".join(repr(f) for f in blocking[:4]),
+    )
+
+
+@rule(
+    "pipeline",
+    "hlo",
+    "compiled wire plan must match the declared pipeline schedule",
+)
+def pipeline(ctx):
+    if not ctx.hlo_text or ctx.schedule is None:
+        return
+    audit = pipeline_audit(ctx.hlo_text, ctx.schedule, mesh=ctx.mesh)
+    if audit.ok:
+        return
+    yield Finding(
+        "pipeline",
+        Severity.ERROR,
+        "hlo",
+        f"compiled collective-permutes do not match the "
+        f"{audit.schedule!r} schedule table: expected "
+        f"{audit.expected_permutes} permutes "
+        f"({audit.expected_fwd} fwd / {audit.expected_bwd} bwd), found "
+        f"{audit.found_permutes} ({audit.fwd_instructions} fwd / "
+        f"{audit.bwd_instructions} bwd, {len(audit.unmatched)} on "
+        "neither channel)",
+        evidence="; ".join(l[:120] for l in audit.unmatched[:2]),
+    )
+
+
+@rule(
+    "recompile-drift",
+    "runtime",
+    "compile-cache entries grew inside a fixed-shape timed window",
+)
+def recompile_drift(ctx):
+    if ctx.cache_entries_before is None or ctx.cache_entries_after is None:
+        return
+    grew = ctx.cache_entries_after - ctx.cache_entries_before
+    if grew <= 0:
+        return
+    yield Finding(
+        "recompile-drift",
+        Severity.ERROR,
+        "runtime:compile-cache",
+        f"{grew} new compile-cache entr{'y' if grew == 1 else 'ies'} "
+        f"appeared during {ctx.cache_window or 'a fixed-shape window'}: "
+        "the step retraced/recompiled mid-measurement (shape drift, "
+        "weak-type flip, or a python-object static arg), so the timing "
+        "includes compilation",
+        evidence=(
+            f"entries {ctx.cache_entries_before} -> "
+            f"{ctx.cache_entries_after}"
+        ),
+    )

@@ -1,0 +1,111 @@
+"""Rule registry: one decorator, one context, one runner.
+
+A rule is a function ``(AnalysisContext) -> iterable[Finding]``
+registered under a unique name on one inspection plane:
+
+- ``trace``: reads ``ctx.jaxpr`` (a ClosedJaxpr of the abstract-evaluated
+  step) — catches hazards before any XLA work happens.
+- ``hlo``: reads ``ctx.hlo_text`` (``compiled.as_text()``) — catches what
+  only the compiler decides (aliasing, collective choice, host
+  transfers).
+- ``runtime``: reads measured facts (compile-cache entry counts) the
+  bench harness records around its timed windows.
+
+Rules self-check their prerequisites and return ``[]`` when the artifact
+or config they inspect is absent — ``run_rules`` never needs a skip
+matrix. A rule that *raises* is a bug and propagates: the analyzer must
+never silently swallow its own failures.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .findings import Finding, Report, ignored_rules
+
+PLANES = ("trace", "hlo", "runtime")
+
+
+@dataclass
+class AnalysisContext:
+    """Everything a rule may inspect. All artifact fields are optional —
+    a rule that needs an absent one returns no findings.
+
+    ``static_args`` carries the values a caller intends to pass as
+    jit static arguments (checked for hashability); ``cache_*`` fields
+    are the bench harness's compile-cache snapshots around a fixed-shape
+    timed window.
+    """
+
+    jaxpr: object = None          # jax.core.ClosedJaxpr of the step
+    hlo_text: str = ""            # compiled.as_text()
+    mesh: object = None           # jax.sharding.Mesh
+    policy: object = None         # parallel.Policy
+    donate: bool = False          # step was built with donate_argnums
+    detect_anomaly: bool = False  # step legitimately hosts a debug callback
+    remat: object = None          # policy/model remat setting (bool|str|None)
+    schedule: object = None       # parallel.PipelineSchedule, if pipelined
+    platform: str = ""            # "cpu" | "tpu" | ...
+    params: object = None         # state.params pytree (for size accounting)
+    static_args: tuple = ()       # values destined for static_argnums
+    cache_entries_before: object = None  # int | None
+    cache_entries_after: object = None   # int | None
+    cache_window: str = ""        # label for the fixed-shape window
+    extras: dict = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class Rule:
+    name: str
+    plane: str
+    doc: str
+    fn: object
+
+
+RULES: dict = {}
+
+
+def rule(name: str, plane: str, doc: str):
+    """Register ``fn(ctx) -> iterable[Finding]`` under ``name``."""
+    if plane not in PLANES:
+        raise ValueError(f"plane {plane!r} not in {PLANES}")
+
+    def deco(fn):
+        if name in RULES:
+            raise ValueError(f"duplicate rule {name!r}")
+        RULES[name] = Rule(name, plane, doc, fn)
+        return fn
+
+    return deco
+
+
+def run_rules(
+    ctx: AnalysisContext,
+    planes=PLANES,
+    ignore=None,
+) -> Report:
+    """Run every registered rule on the requested planes.
+
+    ``ignore`` defaults to ``GRAFT_ANALYZE_IGNORE``; ignored rules still
+    run (they are cheap) and their findings land in
+    ``Report.suppressed`` so the report shows what was muted.
+    """
+    if ignore is None:
+        ignore = ignored_rules()
+    report = Report()
+    ran = []
+    for r in RULES.values():
+        if r.plane not in planes:
+            continue
+        ran.append(r.name)
+        found = list(r.fn(ctx))
+        for f in found:
+            if not isinstance(f, Finding):
+                raise TypeError(
+                    f"rule {r.name!r} yielded {type(f).__name__}, "
+                    "expected Finding"
+                )
+            (report.suppressed if f.rule in ignore
+             else report.findings).append(f)
+    report.rules_run = tuple(ran)
+    return report

@@ -9,6 +9,7 @@ per-step timing.
 from . import wandb_compat as wandb
 from .hlo import (
     CollectiveOp,
+    HloInstruction,
     OverlapAudit,
     OverlapFinding,
     PipelineAudit,
@@ -19,6 +20,7 @@ from .hlo import (
     max_all_reduce_elems,
     overlap_audit,
     pipeline_audit,
+    tokenize_hlo,
 )
 from .memory import (
     MemoryStats,
@@ -40,6 +42,8 @@ __all__ = [
     "TransferOverlapProbe",
     "trace",
     "CollectiveOp",
+    "HloInstruction",
+    "tokenize_hlo",
     "collective_inventory",
     "counts",
     "has_logical_reduce_scatter",

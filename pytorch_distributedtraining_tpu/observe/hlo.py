@@ -16,6 +16,13 @@ to the shard — while XLA:TPU emits a literal ``reduce-scatter``. Audits
 that must hold on both backends should accept either form; see
 ``has_logical_reduce_scatter``.
 
+Everything here parses ``compiled.as_text()`` through ONE tokenizer
+(:func:`tokenize_hlo`): instructions are continuation-merged (long operand
+lists may wrap across physical lines) and tagged with their enclosing
+computation, so ops inside fusion bodies attribute correctly. The three
+audits below and the ``analyze`` rule registry all consume the same
+tokens — there is no per-audit line parsing.
+
 Typical use::
 
     hlo = step.compiled_text(state, batch)       # or any .compile().as_text()
@@ -33,6 +40,9 @@ _OP_RE = re.compile(
     r"all-to-all)(?:-start)?\("
 )
 _SHAPE_RE = re.compile(r"\[([0-9,]*)\]")
+_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.-]+)\s*=")
+_PCT_NAME_RE = re.compile(r"%([\w.-]+)")
+_CALLS_RE = re.compile(r"calls=%?([\w.$-]+)")
 
 
 def _elems(group: str) -> int:
@@ -43,13 +53,102 @@ def _elems(group: str) -> int:
     return n
 
 
+# -- the shared tokenizer -----------------------------------------------------
+
+
+@dataclass(frozen=True)
+class HloInstruction:
+    """One instruction in an HLO text module, continuation-merged.
+
+    ``text`` is the full instruction with wrapped operand lines joined by a
+    space; ``computation`` names the enclosing computation (fusion bodies
+    are their own computations in HLO text, so "is this op inside a
+    fusion?" is a string compare, not a heuristic).
+    """
+
+    name: str         # result name, leading % stripped
+    computation: str  # enclosing computation ("" before the first header)
+    text: str         # merged instruction text, stripped
+
+    def first_operand(self, op_token: str) -> str | None:
+        """Name of the first operand of ``op_token`` in this instruction."""
+        return _first_operand(self.text, op_token)
+
+    def result_elems(self, op_token: str) -> list[int]:
+        """Element counts of every shape group left of ``op_token``
+        (tuple-shaped results report each member)."""
+        lhs = self.text.split(op_token, 1)[0]
+        return [_elems(g) for g in _SHAPE_RE.findall(lhs)]
+
+
+def tokenize_hlo(hlo_text: str) -> tuple:
+    """Parse HLO text into :class:`HloInstruction` tokens, in module order.
+
+    Handles both HLO text styles (``%name = ...`` long form and bare-name
+    short form), tracks computation boundaries (``name (...) -> ... {`` /
+    ``}``), and merges physical continuation lines — an instruction whose
+    operand list wraps is ONE token. Non-instruction lines (module header,
+    computation headers/braces) produce no tokens.
+    """
+    out: list[HloInstruction] = []
+    parts: list[str] | None = None  # accumulating instruction, or None
+    name = ""
+    comp = ""
+
+    def flush():
+        nonlocal parts
+        if parts is not None:
+            out.append(HloInstruction(name, comp, " ".join(parts)))
+            parts = None
+
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if line.rstrip().endswith("{") and "->" in line:
+            # computation header: `[ENTRY] %name (params) -> shape {`
+            flush()
+            comp = (
+                line.split("(")[0].replace("ENTRY", "").strip().lstrip("%")
+            )
+            continue
+        if stripped == "}":
+            flush()
+            continue
+        d = _DEF_RE.match(line)
+        if d is not None:
+            flush()
+            name = d.group(1)
+            parts = [stripped]
+        elif parts is not None and stripped:
+            parts.append(stripped)  # continuation of a wrapped operand list
+    flush()
+    return tuple(out)
+
+
+def _first_operand(line: str, op_token: str) -> str | None:
+    """Name of the first operand of ``op_token`` on ``line``.
+
+    Handles both HLO text styles: the long form prints ``%name`` (possibly
+    after an inline tuple-type annotation), the short form prints bare
+    names with no types.
+    """
+    after = line.split(op_token, 1)[1]
+    m = _PCT_NAME_RE.search(after)
+    if m is not None:
+        return m.group(1)
+    tok = after.split(",")[0].split(")")[0].strip()
+    return tok or None
+
+
+# -- collective inventory -----------------------------------------------------
+
+
 @dataclass(frozen=True)
 class CollectiveOp:
     """One collective in a compiled HLO module."""
 
     kind: str        # all-reduce | reduce-scatter | all-gather | ...
     max_elems: int   # largest result-tensor element count (tuple-aware)
-    line: str        # the HLO line, for debugging failed assertions
+    line: str        # the HLO instruction text, for debugging failed asserts
 
     def __repr__(self) -> str:  # keep pytest output readable
         return f"CollectiveOp({self.kind}, {self.max_elems})"
@@ -58,19 +157,19 @@ class CollectiveOp:
 def collective_inventory(hlo_text: str) -> list[CollectiveOp]:
     """Parse a compiled HLO module's collectives with result sizes.
 
-    Sizes come from the *result* type on the left of ``=`` (per-partition
-    shapes in an SPMD module); tuple-shaped combined collectives report
-    the largest member. Works on ``compiled.as_text()`` output.
+    Sizes come from the *result* type on the left of the op token
+    (per-partition shapes in an SPMD module); tuple-shaped combined
+    collectives report the largest member. Works on
+    ``compiled.as_text()`` output.
     """
     out = []
-    for line in hlo_text.splitlines():
-        m = _OP_RE.search(line)
+    for ins in tokenize_hlo(hlo_text):
+        m = _OP_RE.search(ins.text)
         if m is None:
             continue
-        lhs = line.split(m.group(0))[0]
-        sizes = [_elems(g) for g in _SHAPE_RE.findall(lhs)]
+        sizes = ins.result_elems(m.group(0))
         out.append(
-            CollectiveOp(m.group(1), max(sizes) if sizes else 1, line.strip())
+            CollectiveOp(m.group(1), max(sizes) if sizes else 1, ins.text)
         )
     return out
 
@@ -106,24 +205,6 @@ _PASSTHROUGH_OPS = (
     "all-reduce-done(",
 )
 
-_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.-]+)\s*=")
-_PCT_NAME_RE = re.compile(r"%([\w.-]+)")
-
-
-def _first_operand(line: str, op_token: str) -> str | None:
-    """Name of the first operand of ``op_token`` on ``line``.
-
-    Handles both HLO text styles: the long form prints ``%name`` (possibly
-    after an inline tuple-type annotation), the short form prints bare
-    names with no types.
-    """
-    after = line.split(op_token, 1)[1]
-    m = _PCT_NAME_RE.search(after)
-    if m is not None:
-        return m.group(1)
-    tok = after.split(",")[0].split(")")[0].strip()
-    return tok or None
-
 
 def has_logical_reduce_scatter(hlo_text: str, shard_elems: int) -> bool:
     """True when the module reduce-scatters — literally, or in the CPU
@@ -149,45 +230,38 @@ def has_logical_reduce_scatter(hlo_text: str, shard_elems: int) -> bool:
     # XLA:CPU routinely fuses the slice, so the chain is
     # all-reduce → fusion(operands incl. partition-id) → body dynamic-slice
     ar_names: set[str] = set()
-    ds_comps: list[tuple[str, str, int]] = []  # (computation, operand, elems)
+    ds_comps: list[tuple[str, str]] = []  # (computation, operand)
     fusion_calls: list[tuple[list[str], str]] = []  # (operands, called comp)
-    comp = ""
-    for line in hlo_text.splitlines():
-        if line.rstrip().endswith("{") and "->" in line:
-            comp = (line.split("(")[0].replace("ENTRY", "").strip()
-                    .lstrip("%"))
-            continue
-        d = _DEF_RE.match(line)
-        if d is None:
-            continue
-        name = d.group(1)
-        m = _OP_RE.search(line)
+    for ins in tokenize_hlo(hlo_text):
+        m = _OP_RE.search(ins.text)
         if m is not None and m.group(1) == "all-reduce":
-            ar_names.add(name)
+            ar_names.add(ins.name)
             continue
         for op_token in _PASSTHROUGH_OPS:
-            if op_token in line:
-                src = _first_operand(line, op_token)
-                if src in ar_names:
-                    ar_names.add(name)
+            if op_token in ins.text:
+                if ins.first_operand(op_token) in ar_names:
+                    ar_names.add(ins.name)
                 break
-        if " fusion(" in line:
-            args = line.split(" fusion(", 1)[1].split("kind=")[0]
-            called = re.search(r"calls=%?([\w.$-]+)", line)
+        if " fusion(" in ins.text:
+            args = ins.text.split(" fusion(", 1)[1].split("kind=")[0]
+            called = _CALLS_RE.search(ins.text)
             fusion_calls.append(
                 (_PCT_NAME_RE.findall(args), called.group(1) if called else "")
             )
-        if "dynamic-slice(" in line:
-            lhs = line.split("dynamic-slice(")[0]
-            if any(_elems(g) == shard_elems for g in _SHAPE_RE.findall(lhs)):
-                op_name = _first_operand(line, "dynamic-slice(")
-                ds_comps.append((comp, op_name or "", _elems("1")))
+        if "dynamic-slice(" in ins.text:
+            if any(
+                e == shard_elems
+                for e in ins.result_elems("dynamic-slice(")
+            ):
+                ds_comps.append(
+                    (ins.computation, ins.first_operand("dynamic-slice(") or "")
+                )
 
     # pass 2: a shard-sized slice counts when it reads an all-reduce result
     # directly, or sits in a fusion body whose caller feeds it one
     # (fusion-granularity precision: good enough to reject slices in
     # fusions with no reduction input at all — the coincidental case)
-    for _, operand, _ in ds_comps:
+    for _, operand in ds_comps:
         if operand in ar_names:
             return True
     ar_fed = {
@@ -195,7 +269,7 @@ def has_logical_reduce_scatter(hlo_text: str, shard_elems: int) -> bool:
         for operands, called in fusion_calls
         if called and any(o in ar_names for o in operands)
     }
-    return any(comp in ar_fed for comp, _, _ in ds_comps)
+    return any(comp in ar_fed for comp, _ in ds_comps)
 
 
 def counts(hlo_text: str) -> dict[str, int]:
@@ -267,30 +341,31 @@ def overlap_audit(hlo_text: str) -> OverlapAudit:
     ``-done`` — this counts the instructions in that window (parameters
     excluded) per pair. Works on ``compiled.as_text()`` output.
     """
-    lines = hlo_text.splitlines()
+    instrs = tokenize_hlo(hlo_text)
     findings = []
-    for i, line in enumerate(lines):
-        m = _OP_RE.search(line)
+    for i, ins in enumerate(instrs):
+        m = _OP_RE.search(ins.text)
         if m is None:
             continue
         kind = m.group(1)
-        d = _DEF_RE.match(line)
-        name = d.group(1) if d else ""
-        if f"{kind}-start(" not in line:
+        if f"{kind}-start(" not in ins.text:
             findings.append(
-                OverlapFinding(kind, name, False, 0, line.strip())
+                OverlapFinding(kind, ins.name, False, 0, ins.text)
             )
             continue
         done_token = f"{kind}-done("
         hidden = 0
-        for j in range(i + 1, len(lines)):
-            nxt = lines[j]
-            if done_token in nxt and _first_operand(nxt, done_token) == name:
+        for nxt in instrs[i + 1:]:
+            if (
+                done_token in nxt.text
+                and nxt.first_operand(done_token) == ins.name
+            ):
                 break
-            dj = _DEF_RE.match(nxt)
-            if dj is not None and " parameter(" not in nxt:
+            if " parameter(" not in nxt.text:
                 hidden += 1
-        findings.append(OverlapFinding(kind, name, True, hidden, line.strip()))
+        findings.append(
+            OverlapFinding(kind, ins.name, True, hidden, ins.text)
+        )
     return OverlapAudit(tuple(findings))
 
 
@@ -380,15 +455,15 @@ def pipeline_audit(hlo_text: str, schedule, mesh=None, axis_name: str = "pp"):
     segment table. Run it on ``PipelineStep.compiled_text(...)``.
     """
     found: list[tuple[frozenset, str]] = []
-    for line in hlo_text.splitlines():
-        m = _OP_RE.search(line)
+    for ins in tokenize_hlo(hlo_text):
+        m = _OP_RE.search(ins.text)
         if m is None or m.group(1) != "collective-permute":
             continue
-        pm = _PAIRS_ATTR_RE.search(line)
+        pm = _PAIRS_ATTR_RE.search(ins.text)
         pairs = frozenset(
             (int(a), int(b)) for a, b in _PAIR_RE.findall(pm.group(1))
         ) if pm else frozenset()
-        found.append((pairs, line.strip()))
+        found.append((pairs, ins.text))
 
     expected_fwd = sum(1 for _, _, f, _ in schedule.segments if f)
     expected_bwd = sum(1 for _, _, _, b in schedule.segments if b)

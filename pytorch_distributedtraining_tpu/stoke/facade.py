@@ -1161,6 +1161,87 @@ class Stoke:
 
     # -- fused fast path ---------------------------------------------------
 
+    def _maybe_static_analyze(self, step, batch):
+        """``GRAFT_ANALYZE=warn|error``: run graftcheck once, at first
+        compile of the fused step (the AOT artifacts are free then — the
+        jit cache already holds the lowering). ``warn`` prints the
+        report; ``error`` additionally raises on error-severity findings
+        so a misconfigured pod run dies before burning its first step.
+        Off by default; same env-knob family as GRAFT_REMAT/GRAFT_PP.
+        """
+        from ..analyze import analyze_mode, analyze_step
+
+        mode = analyze_mode()
+        if mode == "off":
+            return
+        report = analyze_step(
+            step, self._state, batch, lr_factor=self._opt_handle.lr
+        )
+        print(report.render())
+        if mode == "error" and not report.ok:
+            raise RuntimeError(
+                f"GRAFT_ANALYZE=error: graftcheck found "
+                f"{len(report.errors)} error-severity finding(s) in the "
+                "fused step; see report above (suppress individual rules "
+                "via GRAFT_ANALYZE_IGNORE)"
+            )
+
+    def _build_fused(self):
+        """Construct the fused TrainStep once, without executing a step.
+        Shared by ``fused_step`` and ``static_analyze`` so graftcheck can
+        inspect the exact program the fast path would run."""
+        if self._fused is not None:
+            return self._fused
+        module_apply = self._apply_model
+        loss_callable = self._loss_callable
+
+        def loss_fn(params, batch, rng, model_state):
+            x, y = batch
+            out, new_state = module_apply(params, model_state, x, True, rng)
+            loss = loss_callable(out, y)
+            aux = {"model_state": new_state} if new_state else {}
+            return loss, aux
+
+        self._fused = TrainStep(
+            loss_fn,
+            self._tx,
+            self.mesh,
+            self.policy,
+            grad_accum_steps=self.grad_accum_steps,
+            precision=self.precision,
+            loss_scaler=self.loss_scaler,
+            state_shardings=self._shardings,
+            donate=self.tpu_config.donate_state,
+            # a FusedAdamW carries its own flat wire dtype (set at
+            # init()); the per-leaf knob is the tree path's
+            update_wire_dtype=(
+                None
+                if isinstance(self._tx, optim_mod.FusedAdamW)
+                else self._update_wire_dtype()
+            ),
+        )
+        return self._fused
+
+    def static_analyze(self, inputs, targets):
+        """Run graftcheck against the fused step and return the Report,
+        without taking a device step. For drivers on the eager
+        loss/backward/step surface this is the way to analyze the program
+        they *would* run fused — the constructed TrainStep is cached, so a
+        later ``fused_step`` pays no second trace. The caller decides what
+        to do with the report (print / abort); no env knob is consulted.
+        """
+        from ..analyze import analyze_step
+
+        if self._state is None:
+            self.init(inputs)
+        step = self._build_fused()
+        return analyze_step(
+            step,
+            self._state,
+            (self._shard_batch(inputs), self._shard_batch(targets)),
+            lr_factor=self._opt_handle.lr,
+        )
+
     def fused_step(self, inputs, targets):
         """One compiled program for fwd+bwd+accum+clip+update — the TPU fast
         path. Returns the metrics dict. State is shared with the eager
@@ -1168,33 +1249,9 @@ class Stoke:
         if self._state is None:
             self.init(inputs)
         if self._fused is None:
-            module_apply = self._apply_model
-            loss_callable = self._loss_callable
-
-            def loss_fn(params, batch, rng, model_state):
-                x, y = batch
-                out, new_state = module_apply(params, model_state, x, True, rng)
-                loss = loss_callable(out, y)
-                aux = {"model_state": new_state} if new_state else {}
-                return loss, aux
-
-            self._fused = TrainStep(
-                loss_fn,
-                self._tx,
-                self.mesh,
-                self.policy,
-                grad_accum_steps=self.grad_accum_steps,
-                precision=self.precision,
-                loss_scaler=self.loss_scaler,
-                state_shardings=self._shardings,
-                donate=self.tpu_config.donate_state,
-                # a FusedAdamW carries its own flat wire dtype (set at
-                # init()); the per-leaf knob is the tree path's
-                update_wire_dtype=(
-                    None
-                    if isinstance(self._tx, optim_mod.FusedAdamW)
-                    else self._update_wire_dtype()
-                ),
+            self._maybe_static_analyze(
+                self._build_fused(),
+                (self._shard_batch(inputs), self._shard_batch(targets)),
             )
         self._state, metrics = self._fused(
             self._state,

@@ -42,6 +42,7 @@ from pytorch_distributedtraining_tpu.observe.hlo import (
     counts,
     has_logical_reduce_scatter,
     max_all_reduce_elems,
+    tokenize_hlo,
 )
 from pytorch_distributedtraining_tpu.parallel import (
     DDP,
@@ -282,3 +283,67 @@ class TestInventoryParser:
     def test_scalar_shapes(self):
         inv = collective_inventory("%r = f32[] all-reduce(%x)")
         assert inv[0].max_elems == 1
+
+
+class TestTokenizer:
+    """tokenize_hlo edge cases: fusion bodies, wrapped operand lists,
+    computation attribution (no compilation involved)."""
+
+    MODULE = "\n".join([
+        "HloModule jit_step, entry_computation_layout="
+        "{(f32[18432]{0})->f32[2304]{0}}",
+        "",
+        "%fused_computation (param_0.1: f32[18432], param_1.2: u32[]) "
+        "-> f32[2304] {",
+        "  %param_0.1 = f32[18432]{0} parameter(0)",
+        "  %param_1.2 = u32[] parameter(1)",
+        "  ROOT %ds.9 = f32[2304]{0} dynamic-slice(%param_0.1, "
+        "%param_1.2), dynamic_slice_sizes={2304}",
+        "}",
+        "",
+        "ENTRY %main.42 (p0: f32[18432]) -> f32[2304] {",
+        "  %p0 = f32[18432]{0} parameter(0)",
+        # wrapped operand list: ONE instruction across three lines
+        "  %ar.5 = f32[18432]{0} all-reduce(%p0, %p0,",
+        "      %p0, %p0), replica_groups={{0,1,2,3,4,5,6,7}},"
+        " to_apply=%add.3",
+        "  %pid.2 = u32[] partition-id()",
+        "  ROOT %fus = f32[2304]{0} fusion(%ar.5, %pid.2), kind=kLoop, "
+        "calls=%fused_computation",
+        "}",
+    ])
+
+    def test_fusion_body_ops_attribute_to_their_computation(self):
+        toks = {t.name: t for t in tokenize_hlo(self.MODULE)}
+        assert toks["ds.9"].computation == "fused_computation"
+        assert toks["ar.5"].computation == "main.42"
+        assert toks["fus"].computation == "main.42"
+
+    def test_multiline_operands_merge_into_one_token(self):
+        ar = [t for t in tokenize_hlo(self.MODULE) if t.name == "ar.5"]
+        assert len(ar) == 1
+        # the wrapped tail (second operand line + attributes) joined in
+        assert "to_apply=%add.3" in ar[0].text
+        assert "replica_groups" in ar[0].text
+        inv = [
+            op for op in collective_inventory(self.MODULE)
+            if op.kind == "all-reduce"
+        ]
+        assert len(inv) == 1 and inv[0].max_elems == 18432
+        assert counts(self.MODULE) == {"all-reduce": 1}
+
+    def test_fusion_body_slice_counts_as_logical_reduce_scatter(self):
+        # the CPU fused form: all-reduce feeds a fusion whose body holds
+        # the shard-sized dynamic-slice — crosses a computation boundary
+        assert has_logical_reduce_scatter(self.MODULE, 2304)
+        # a shard size nothing slices to must not match
+        assert not has_logical_reduce_scatter(self.MODULE, 999)
+
+    def test_headers_and_braces_produce_no_tokens(self):
+        names = [t.name for t in tokenize_hlo(self.MODULE)]
+        assert "fused_computation" not in names
+        assert "main.42" not in names
+        assert "jit_step" not in names
+        # every real instruction is tokenized exactly once
+        assert names == ["param_0.1", "param_1.2", "ds.9", "p0", "ar.5",
+                         "pid.2", "fus"]
