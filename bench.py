@@ -342,7 +342,7 @@ _ARM_ENVS = (  # envs that change WHICH arm is being measured
     "GRAFT_BENCH_OPT", "GRAFT_BENCH_ATTN", "GRAFT_BENCH_ATTN_PACK",
     "GRAFT_BENCH_NORM", "GRAFT_BENCH_SOFTMAX", "GRAFT_BENCH_LOOP",
     "GRAFT_BENCH_SCAN_K", "GRAFT_BENCH_FEED", "GRAFT_BENCH_PREFETCH",
-    "GRAFT_REMAT", "GRAFT_SCAN_LAYERS",
+    "GRAFT_REMAT", "GRAFT_SCAN_LAYERS", "GRAFT_WIRE", "GRAFT_FP8",
 )
 
 
@@ -856,13 +856,14 @@ def _bench() -> None:
         unknown = set(knobs) - {
             "attn", "attn_pack", "norm", "softmax", "opt", "loop", "scan_k",
             "feed", "remat", "scan_layers", "pp", "pp_schedule", "pp_micro",
+            "wire",
         }
         if unknown:
             # a typoed key would otherwise silently no-op the default flip
             raise SystemExit(
                 f"bench_knobs.json unknown keys {sorted(unknown)}; valid: "
                 "attn, attn_pack, norm, softmax, opt, loop, scan_k, feed, "
-                "remat, scan_layers, pp, pp_schedule, pp_micro"
+                "remat, scan_layers, pp, pp_schedule, pp_micro, wire"
             )
 
     resolved = {}  # effective value + where it came from, for the log line
@@ -941,6 +942,46 @@ def _bench() -> None:
         raise SystemExit(
             f"feed must be 'prefetch' or 'resident', got {feed_impl!r}"
         )
+    # quantized gradient wire (parallel/compressed.py): a non-off value
+    # swaps the timed step for CompressedGradStep carrying gradients in
+    # the named narrow format (int8 | int8_block | fp8_e4m3 | fp8_e5m2,
+    # optional :BLOCK suffix); the record then carries wire_format /
+    # wire_bytes and the convergence A/B gate below guards publication
+    from pytorch_distributedtraining_tpu.parallel import wire_format
+
+    wire_raw = knob("GRAFT_WIRE", "wire", "")
+    try:
+        wire_fmt = wire_format(wire_raw)
+    except ValueError as e:
+        raise SystemExit(f"wire: {e} (from {resolved['wire'][1]})")
+    # GRAFT_FP8 is the facade/driver knob for the fp8 matmul path, which
+    # the GPT-2/ViT trunks implement; the SwinIR flagship has no fp8
+    # tagging, so a leaked value must not benchmark a mislabeled arm
+    if os.environ.get("GRAFT_FP8", "").strip().lower() not in (
+        "", "off", "none", "0", "false",
+    ):
+        raise SystemExit(
+            "GRAFT_FP8 has no effect on the SwinIR flagship trunk (the "
+            "fp8 matmul path covers GPT-2/ViT via precision."
+            "fp8_dot_general_cls) — unset it; fp8 arms live in ladder.py "
+            "and the facade"
+        )
+    # The quantized wire is a per-leaf path (block scales follow leaf
+    # shape); FusedAdamW ravels grads flat and has no optax .update. When
+    # the fused winner merely rode in from bench_knobs.json/default, the
+    # wire arm overrides it to the tree chain — attributed below so the
+    # knobs line never mislabels the arm. An explicit env contradiction is
+    # the operator asking for both at once: refuse, don't pick.
+    if wire_fmt is not None and opt_impl == "fused":
+        if resolved["opt"][1] == "env":
+            raise SystemExit(
+                "GRAFT_WIRE and GRAFT_BENCH_OPT=fused contradict: the "
+                "quantized wire needs the per-leaf optax chain "
+                "(FusedAdamW's flat update has no per-leaf wire) — drop "
+                "one of the two"
+            )
+        opt_impl = "chain"
+        resolved["opt"] = ("chain", "wire-override")
 
     # timing-loop knobs parse HERE, before any compile time is spent —
     # same never-benchmark-a-mislabeled-arm convention as attn_pack/opt
@@ -1006,13 +1047,36 @@ def _bench() -> None:
         policy=policy,
         # params stay f32 master copies; compute casts to bf16 in-model
     )
-    step = TrainStep(
-        loss_fn, tx, mesh, policy,
-        precision=Precision(),
-        state_shardings=shardings,
-        extra_metrics=False,
-        donate=True,
+    if wire_fmt is not None:
+        if loop_impl == "scan":
+            # MultiStep scans step._step without the residual auto-init
+            # the quantized step's __call__ performs
+            raise SystemExit(
+                "wire arm composes with the host loop only "
+                "(GRAFT_BENCH_LOOP=scan measures dispatch cost, not wire)"
+            )
+        from pytorch_distributedtraining_tpu.parallel import (
+            CompressedGradStep,
+        )
+
+        step = CompressedGradStep(
+            loss_fn, tx, mesh, policy, donate=True, wire=wire_fmt
+        )
+    else:
+        step = TrainStep(
+            loss_fn, tx, mesh, policy,
+            precision=Precision(),
+            state_shardings=shardings,
+            extra_metrics=False,
+            donate=True,
+        )
+    # bytes-on-wire accounting for the record: analytic per-step gradient
+    # collective traffic in the chosen format vs the f32 wire it replaces
+    wire_info = (
+        step.wire_cost(state.params) if wire_fmt is not None else None
     )
+    if wire_info is not None:
+        print(f"# child: wire {json.dumps(wire_info)}", flush=True)
 
     rng = np.random.default_rng(0)
     # a small pool of DISTINCT samples so the prefetch feed stages real,
@@ -1319,6 +1383,81 @@ def _bench() -> None:
             raise
         except Exception as e:  # noqa: BLE001 — analyzer crash != finding
             print(f"# child: graftcheck unavailable: {e}", flush=True)
+    # Convergence A/B gate (untimed; runs AFTER graftcheck so its extra
+    # compiles land outside the recompile-drift window): a short fp32
+    # TrainStep run vs the quantized step, both from identical init
+    # params over the same batch sequence. A quantized loss that drifts
+    # past tolerance means the wire format is eating the model, and the
+    # throughput number must not publish (exit 8 — deterministic, the
+    # parent emits an error record, never a headline value).
+    # GRAFT_WIRE_GATE=0 skips; _STEPS / _TOL resize the probe.
+    wire_gate = None
+    if wire_fmt is not None and os.environ.get(
+        "GRAFT_WIRE_GATE", "1"
+    ).strip().lower() not in ("0", "false", "off", "no"):
+        gate_steps = max(2, int_env("GRAFT_WIRE_GATE_STEPS", "12"))
+        try:
+            gate_tol = float(os.environ.get("GRAFT_WIRE_GATE_TOL", "0.05"))
+        except ValueError:
+            raise SystemExit("GRAFT_WIRE_GATE_TOL must be a float")
+        print(
+            f"# child: convergence gate: {gate_steps} steps fp32 vs "
+            f"{wire_fmt.name}, tol {gate_tol}",
+            flush=True,
+        )
+        # same init rng as the timed run -> identical starting params
+        ref_state, _ = create_train_state(
+            init_fn=lambda rng: (
+                model.init(rng, jnp.zeros((1, PATCH, PATCH, 3)))["params"],
+                {},
+            ),
+            tx=tx, mesh=mesh, policy=policy,
+        )
+        q_state, _ = create_train_state(
+            init_fn=lambda rng: (
+                model.init(rng, jnp.zeros((1, PATCH, PATCH, 3)))["params"],
+                {},
+            ),
+            tx=tx, mesh=mesh, policy=policy,
+        )
+        ref_step = TrainStep(
+            loss_fn, tx, mesh, policy,
+            precision=Precision(), extra_metrics=False, donate=False,
+        )
+        gate_batches = [
+            (
+                jax.device_put(lr_all[j * BATCH:(j + 1) * BATCH]),
+                jax.device_put(hr_all[j * BATCH:(j + 1) * BATCH]),
+            )
+            for j in range(n_distinct // BATCH)
+        ]
+        with mesh:
+            for i in range(gate_steps):
+                b = gate_batches[i % len(gate_batches)]
+                ref_state, m_ref = ref_step(ref_state, b)
+                q_state, m_q = step(q_state, b)
+            ref_loss = float(m_ref["loss"])
+            q_loss = float(m_q["loss"])
+        rel_delta = abs(q_loss - ref_loss) / max(abs(ref_loss), 1e-12)
+        wire_gate = {
+            "steps": gate_steps,
+            "fp32_loss": round(ref_loss, 6),
+            "quantized_loss": round(q_loss, 6),
+            "rel_delta": round(rel_delta, 6),
+            "tol": gate_tol,
+        }
+        print(f"# child: wire gate {json.dumps(wire_gate)}", flush=True)
+        if not np.isfinite(q_loss) or rel_delta > gate_tol:
+            # no "# " prefix: _informative_tail must pick THIS line as
+            # the cause in the parent's error record
+            print(
+                f"CONVERGENCE GATE: quantized wire {wire_fmt.name} loss "
+                f"{q_loss:.6f} vs fp32 {ref_loss:.6f} after {gate_steps} "
+                f"steps (rel delta {rel_delta:.4f} > tol {gate_tol}) — "
+                "refusing to publish",
+                flush=True,
+            )
+            sys.exit(8)
     # HBM accounting (untimed, after the windows): XLA's memory plan for
     # the compiled step — the persistent compile cache makes this AOT
     # lower+compile a cheap deserialize, not a second cold compile. None
@@ -1405,6 +1544,16 @@ def _bench() -> None:
                 "peak_hbm_bytes": peak_hbm_bytes,
                 "remat": remat_impl,
                 "scan_layers": scan_layers,
+                "wire_format": (
+                    wire_info["wire_format"] if wire_info else None
+                ),
+                "wire_bytes": (
+                    wire_info["wire_bytes"] if wire_info else None
+                ),
+                "wire_fp32_bytes": (
+                    wire_info["fp32_bytes"] if wire_info else None
+                ),
+                "wire_gate": wire_gate,
                 "pp": pp_impl,
                 "pp_schedule": pp_schedule_impl if pp_impl > 1 else None,
                 "bubble_fraction": bubble_fraction,

@@ -33,6 +33,12 @@ STAGES = [
     ("bench_pp", "bench.py, GRAFT_PP=4 (pipeline provenance probe arm)"),
     ("bench_resident", "bench.py, GRAFT_BENCH_FEED=resident (no input pipe)"),
     # round-5 chain stage names (benchmarks/tpu_chain.sh r5)
+    ("wire", "bytes moved + step time per gradient wire format "
+             "(wire_bench.py)"),
+    ("bench_wire_int8", "bench.py, GRAFT_WIRE=int8 (quantized gradient "
+                        "collectives + convergence gate)"),
+    ("bench_wire_fp8", "bench.py, GRAFT_WIRE=fp8_e4m3 (block-scaled fp8 "
+                       "wire + convergence gate)"),
     ("dispatch_probe", "tunnel dispatch-cost decomposition (dispatch_probe.py)"),
     ("bench_scan_k10", "bench.py, fused + lax.scan k=10 per dispatch"),
     ("bench_scan_k25", "bench.py, fused + lax.scan k=25 per dispatch"),
@@ -84,6 +90,8 @@ ARM_KNOBS = {
     "bench_remat": "GRAFT_REMAT=full",
     "bench_scan_layers": "GRAFT_SCAN_LAYERS=1",
     "bench_pp": "GRAFT_PP=4 GRAFT_PP_SCHEDULE=1f1b",
+    "bench_wire_int8": "GRAFT_WIRE=int8",
+    "bench_wire_fp8": "GRAFT_WIRE=fp8_e4m3",
 }
 
 

@@ -1,0 +1,142 @@
+"""Quantized-wire microbenchmark: bytes moved + step time per WireFormat.
+
+Times the SAME tiny-MLP DDP train step through every registered wire
+format (parallel/compressed.py) plus the fp32 TrainStep baseline, on an
+8-way CPU device mesh — so the A/B isolates the gradient-exchange
+encoding, not the model. Per arm it reports the analytic bytes-on-wire
+(`CompressedGradStep.wire_cost`) next to the measured step time; on CPU
+the narrow encode/decode is pure overhead (host "links" are memcpys), so
+CPU step-time deltas only bound the compute cost of the codec — the
+bandwidth win the bytes column promises needs a real DCN hop to show up
+in wall clock. That is exactly the split the two columns exist for.
+
+Prints one JSON line per arm: {"arm", "wire_bytes", "fp32_bytes",
+"wire_fraction_quantized", "step_ms"} plus a final {"summary": ...}
+line. ``GRAFT_WIRE_BENCH_STEPS`` / ``_BATCH`` / ``_DIM`` resize the run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+# an 8-way CPU mesh so the collectives are real (must precede jax import)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+
+STEPS = int(os.environ.get("GRAFT_WIRE_BENCH_STEPS", "30"))
+BATCH = int(os.environ.get("GRAFT_WIRE_BENCH_BATCH", "32"))
+DIM = int(os.environ.get("GRAFT_WIRE_BENCH_DIM", "256"))
+
+ARMS = ("fp32", "int8", "int8_block", "fp8_e4m3", "fp8_e5m2")
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributedtraining_tpu import optim
+    from pytorch_distributedtraining_tpu.parallel import (
+        DDP,
+        CompressedGradStep,
+        TrainStep,
+        create_train_state,
+    )
+    from pytorch_distributedtraining_tpu.runtime.mesh import (
+        MeshSpec, make_mesh,
+    )
+
+    n_dev = min(8, jax.device_count())
+    mesh = make_mesh(MeshSpec(dp=n_dev), devices=jax.devices()[:n_dev])
+    rng = np.random.default_rng(0)
+    x_host = rng.normal(size=(BATCH, DIM)).astype(np.float32)
+    y_host = rng.normal(size=(BATCH, 1)).astype(np.float32)
+
+    def init_fn(r):
+        k1, k2, k3 = jax.random.split(r, 3)
+        # two wire-sized kernels (>= the 2048-elem floor) + floored biases,
+        # so every arm exercises both the quantized and the f32 paths
+        return {
+            "w1": jax.random.normal(k1, (DIM, 2 * DIM)) * 0.05,
+            "b1": jnp.zeros((2 * DIM,)),
+            "w2": jax.random.normal(k2, (2 * DIM, DIM)) * 0.05,
+            "b2": jnp.zeros((DIM,)),
+            "out": jax.random.normal(k3, (DIM, 1)) * 0.05,
+        }, {}
+
+    def loss_fn(params, batch, rng_, ms):
+        xb, yb = batch
+        h = jnp.tanh(xb @ params["w1"] + params["b1"])
+        h = jnp.tanh(h @ params["w2"] + params["b2"])
+        return jnp.mean((h @ params["out"] - yb) ** 2), {}
+
+    tx = optim.adamw(lr=1e-3)
+
+    def run(arm: str) -> dict:
+        policy = DDP()
+        state, sh = create_train_state(
+            init_fn=init_fn, tx=tx, mesh=mesh, policy=policy
+        )
+        if arm == "fp32":
+            step = TrainStep(
+                loss_fn, tx, mesh, policy, state_shardings=sh,
+                extra_metrics=False,
+            )
+            cost = None
+        else:
+            step = CompressedGradStep(loss_fn, tx, mesh, policy, wire=arm)
+            cost = step.wire_cost(state.params)
+        batch = (jnp.asarray(x_host), jnp.asarray(y_host))
+        with mesh:
+            state, metrics = step(state, batch)  # compile + residual init
+            jax.block_until_ready(metrics["loss"])
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                state, metrics = step(state, batch)
+            jax.block_until_ready(metrics["loss"])
+            dt = time.perf_counter() - t0
+        row = {
+            "arm": arm,
+            "step_ms": round(1e3 * dt / STEPS, 3),
+            "wire_bytes": cost["wire_bytes"] if cost else None,
+            "fp32_bytes": cost["fp32_bytes"] if cost else None,
+            "wire_fraction_quantized": (
+                cost["wire_fraction_quantized"] if cost else None
+            ),
+            "final_loss": round(float(metrics["loss"]), 6),
+        }
+        print(json.dumps(row), flush=True)
+        return row
+
+    rows = [run(a) for a in ARMS]
+    base = rows[0]
+    best_bytes = min(
+        (r for r in rows if r["wire_bytes"]), key=lambda r: r["wire_bytes"]
+    )
+    print(json.dumps({
+        "summary": "wire_bench",
+        "devices": n_dev,
+        "steps": STEPS,
+        "fp32_step_ms": base["step_ms"],
+        "min_wire_bytes_arm": best_bytes["arm"],
+        "min_wire_bytes": best_bytes["wire_bytes"],
+        "bytes_vs_fp32": round(
+            best_bytes["wire_bytes"] / max(best_bytes["fp32_bytes"], 1), 4
+        ),
+        "platform": jax.devices()[0].platform,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -221,6 +221,20 @@ def build_parser():
                         choices=["gpipe", "1f1b", "interleaved"],
                         help="pipeline schedule for pipelined steps (env "
                              "twin $GRAFT_PP_SCHEDULE)")
+    parser.add_argument("--wire", type=str,
+                        default=os.environ.get("GRAFT_WIRE"),
+                        help="quantized gradient wire for the fused step: "
+                             "int8/int8_block/fp8_e4m3/fp8_e5m2, optional "
+                             ":BLOCK suffix (env twin $GRAFT_WIRE). Note "
+                             "this driver's grad_accum_steps=2 + amp fall "
+                             "back to the f32 wire with a warning — use "
+                             "--fp16 bf16 off and accum 1 paths to engage")
+    parser.add_argument("--fp8", type=str, default=os.environ.get("GRAFT_FP8"),
+                        choices=[None, "e4m3", "e5m2"],
+                        help="fp8 matmul mode for models with an fp8 "
+                             "config field (GPT-2/ViT; env twin $GRAFT_FP8"
+                             "). SwinIR has no fp8 tagging — the facade "
+                             "warns and keeps the model dtype")
     parser.add_argument("--analyze", type=str, nargs="?", const="error",
                         default=os.environ.get("GRAFT_ANALYZE"),
                         choices=["warn", "error", "off"],
@@ -290,6 +304,16 @@ def main(argv=None):
     if opt.analyze:
         os.environ["GRAFT_ANALYZE"] = opt.analyze
         print(f"===> graftcheck analyze={opt.analyze}")
+
+    # --wire/--fp8 thread the low-precision knobs through their env twins
+    # (the facade validates spellings and warn-falls-back when the fused
+    # step cannot compose — e.g. this driver's grad_accum_steps=2)
+    if opt.wire:
+        os.environ["GRAFT_WIRE"] = opt.wire
+        print(f"===> quantized gradient wire={opt.wire}")
+    if opt.fp8:
+        os.environ["GRAFT_FP8"] = opt.fp8
+        print(f"===> fp8 matmul mode={opt.fp8}")
 
     optimizer = StokeOptimizer(
         optimizer="AdamW",

@@ -53,6 +53,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="remat policy: full|dots|names|offload (default off)",
     )
     p.add_argument(
+        "--wire", default=None,
+        help="analyze a CompressedGradStep carrying gradients in this "
+        "wire format (int8 | int8_block | fp8_e4m3 | fp8_e5m2, with an "
+        "optional :BLOCK suffix); the wire-backoff rule then audits "
+        "bytes-on-wire in the compiled HLO",
+    )
+    p.add_argument(
         "--pp", type=int, default=0,
         help="pipeline stages: analyze an MLP PipelineStep on a pp mesh",
     )
@@ -129,6 +136,7 @@ def _build_model_step(args, mesh_kw):
     from ..losses import mse_loss
     from ..parallel import (
         DDP,
+        CompressedGradStep,
         TrainStep,
         ZeRO1,
         ZeRO2,
@@ -186,9 +194,19 @@ def _build_model_step(args, mesh_kw):
         init_fn=lambda r: (model.init(r, init_x)["params"], {}),
         tx=tx, mesh=mesh, policy=policy,
     )
-    step = TrainStep(
-        loss_fn, tx, mesh, policy, state_shardings=sh, donate=args.donate
-    )
+    if args.wire:
+        if args.policy == "zero3":
+            raise SystemExit(
+                "error: --wire composes with ddp/zero1/zero2 only "
+                "(ZeRO-3's sharded params need TrainStep)"
+            )
+        step = CompressedGradStep(
+            loss_fn, tx, mesh, policy, donate=args.donate, wire=args.wire
+        )
+    else:
+        step = TrainStep(
+            loss_fn, tx, mesh, policy, state_shardings=sh, donate=args.donate
+        )
     return step, state, (x, y)
 
 
@@ -312,6 +330,8 @@ def main(argv=None) -> int:
     else:
         step, state, batch = _build_model_step(args, mesh_kw)
         label = f"{args.model} mesh={mesh_kw} policy={args.policy}"
+        if args.wire:
+            label += f" wire={args.wire}"
         expected = None
 
     report = analyze_step(step, state, batch, ignore=ignore)

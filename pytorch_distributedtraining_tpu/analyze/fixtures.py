@@ -179,6 +179,51 @@ def giant_constant(devices=None):
     return step, state, _batch(), ("giant-constant", Severity.WARN)
 
 
+def wire_backoff_fixture(devices=None):
+    """Claims an int8 wire but psums raw f32 gradients — the narrow
+    transport never compiled. This is the real hazard class: summing
+    int8 payloads as ``psum(q.astype(int32))`` emits an s32 all-reduce,
+    so the 'quantized' step ships full-width bytes."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.collectives import shard_map
+
+    mesh = _mesh(devices)
+
+    def fn(state, batch, lr_factor):
+        x, y = batch
+
+        def local(w, x, y):
+            def loss_f(w):
+                return jnp.mean((x @ w - y) ** 2)
+
+            loss, g = jax.value_and_grad(loss_f)(w)
+            # f32 all-reduce of a wire-sized gradient: the violation
+            g = lax.psum(g, "dp")
+            return w - lr_factor * 1e-3 * g, lax.pmean(loss, "dp")
+
+        return shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P("dp"), P("dp")),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )(state["w"], x, y)
+
+    rng = np.random.default_rng(0)
+    # the leaf must clear the wire format's size floor (MIN_WIRE_ELEMS)
+    # or the rule would legitimately excuse its f32 collective
+    state = {"w": jnp.zeros((256, 16), jnp.float32)}
+    batch = (
+        jnp.asarray(rng.normal(size=(16, 256)).astype(np.float32)),
+        jnp.asarray(rng.normal(size=(16, 16)).astype(np.float32)),
+    )
+    step = _FixtureStep(fn, mesh, donate=False)
+    step.wire = "int8"  # the claim the compiled module fails to honor
+    return step, state, batch, ("wire-backoff", Severity.ERROR)
+
+
 def untagged_remat(devices=None):
     """remat='names' over a model with no checkpoint_name tags: the
     policy saves nothing and silently degrades to full remat."""
@@ -193,6 +238,7 @@ FIXTURES = {
     "io-callback": io_callback_in_loss,
     "giant-constant": giant_constant,
     "untagged-remat": untagged_remat,
+    "wire-backoff": wire_backoff_fixture,
 }
 
 

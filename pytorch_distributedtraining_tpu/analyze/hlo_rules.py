@@ -187,6 +187,95 @@ def sharding_backoff(ctx):
 
 
 @rule(
+    "wire-backoff",
+    "hlo",
+    "quantized gradient wire must carry the narrow dtype",
+)
+def wire_backoff(ctx):
+    """Bytes-on-wire audit for :class:`~..parallel.compressed
+    .CompressedGradStep`-shaped steps: when a step claims a wire format
+    (``ctx.wire``, auto-threaded from ``step.wire``), the compiled
+    gradient collectives must actually carry the narrow dtype. The
+    hazard class is real: ``psum(q.astype(int32))`` — the obvious way to
+    sum int8 payloads — compiles to an s32 all-reduce, quietly shipping
+    4x the bytes the format promised. Scale tensors legitimately ride
+    f32 beside the payload at ~1/block the elements, and leaves under
+    the format's size floor legitimately stay f32 — both are budgeted
+    out before anything is called a violation. Backend caveat (same as
+    :func:`~..observe.hlo.has_logical_reduce_scatter`): XLA:CPU
+    legalizes f8 collectives to f16, so f16 counts as narrow.
+    """
+    fmt = getattr(ctx, "wire", None)
+    if not ctx.hlo_text or fmt is None:
+        return
+    if ctx.schedule is not None:
+        return  # pipeline permutes re-home activations, not gradients
+    from ..observe.hlo import WIRE_NARROW_DTYPES, wire_inventory
+    from ..parallel.compressed import wire_format as _resolve_wire
+    from ..runtime.mesh import data_axes
+
+    fmt = _resolve_wire(fmt)
+    if fmt is None:
+        return
+    if ctx.params is not None:
+        import jax
+
+        leaves = jax.tree.leaves(ctx.params)
+        if leaves and all(
+            getattr(p, "size", 0) < fmt.min_wire_elems for p in leaves
+        ):
+            # every leaf is under the format's size floor: the step
+            # legitimately keeps the whole wire f32, nothing to audit
+            return
+    inv = [
+        c for c in wire_inventory(ctx.hlo_text)
+        if c.kind != "collective-permute"
+    ]
+    narrow = [c for c in inv if c.dtype in WIRE_NARROW_DTYPES]
+    axes = data_axes(ctx.mesh) if ctx.mesh is not None else []
+    n = 1
+    for a in axes:
+        n *= ctx.mesh.shape[a]
+    if len(axes) <= 1:
+        # pure-dp mesh: every large gradient collective must be narrow.
+        # (On a hybrid ICI x DCN mesh the fsdp hop legitimately reduces
+        # full-size f32 on the fast links — only presence is checked.)
+        max_narrow = max((c.elems for c in narrow), default=0)
+        scale_budget = (max_narrow // fmt.block) if fmt.block else 0
+        threshold = max(fmt.min_wire_elems, 2 * scale_budget)
+        offenders = [
+            c for c in inv
+            if c.dtype not in WIRE_NARROW_DTYPES and c.elems >= threshold
+        ]
+        if offenders:
+            worst = max(offenders, key=lambda c: c.elems)
+            yield Finding(
+                "wire-backoff",
+                Severity.ERROR,
+                f"hlo:{worst.kind}",
+                f"step claims wire format {fmt.name!r} but "
+                f"{len(offenders)} gradient-sized collective"
+                f"{'s' if len(offenders) != 1 else ''} carr"
+                f"{'y' if len(offenders) != 1 else 'ies'} a wide dtype "
+                f"(worst: {worst.dtype} x {worst.elems} elems): the "
+                "narrow transport backed off — the claimed bandwidth "
+                "saving is not happening on the wire",
+                evidence="; ".join(repr(c) for c in offenders[:4]),
+            )
+    if n > 1 and not narrow:
+        yield Finding(
+            "wire-backoff",
+            Severity.ERROR,
+            "hlo",
+            f"step claims wire format {fmt.name!r} on a {n}-way data "
+            "mesh but the module has NO narrow-dtype collective "
+            f"(accepted: {sorted(WIRE_NARROW_DTYPES)}): every gradient "
+            "byte is crossing the wire at full width",
+            evidence=f"collectives={[repr(c) for c in inv[:6]]}",
+        )
+
+
+@rule(
     "overlap",
     "hlo",
     "collectives stuck on the critical path (no async overlap)",

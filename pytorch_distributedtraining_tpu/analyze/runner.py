@@ -61,6 +61,10 @@ def build_context(step, state, batch, lr_factor=1.0, *, static_args=(),
         params=params,
         static_args=tuple(static_args),
     )
+    # CompressedGradStep (and the wire fixtures) carry their WireFormat
+    # on .wire — auto-thread it so the bytes-on-wire rule sees it without
+    # every caller plumbing an extra kwarg
+    extra.setdefault("wire", getattr(step, "wire", None))
     for k, v in extra.items():
         setattr(ctx, k, v)
     return ctx

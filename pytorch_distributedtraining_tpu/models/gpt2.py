@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
+from ..precision import fp8_dot_general_cls
 from .scan_utils import remat_block
 
 AttnFn = Callable[..., jnp.ndarray]  # (q, k, v, *, causal) -> out
@@ -89,6 +90,11 @@ class GPT2Config:
     # converts loop-layout checkpoints. Ignored under `decode=True` (the KV
     # cache keeps the unrolled loop).
     scan_layers: bool = False
+    # Narrow the block Dense matmuls to fp8 operands ("e4m3"/"e5m2" forward
+    # dtype; backward cotangents always e5m2): amax histories land in the
+    # "fp8" variable collection, riding TrainState.model_state. The tied
+    # embedding matmul stays at cfg.dtype (vocab-sized amax is outlier-bound).
+    fp8: str | None = None
 
     @staticmethod
     def gpt2_125m() -> "GPT2Config":
@@ -171,6 +177,7 @@ class Block(nn.Module):
         dense = lambda feat, name: nn.Dense(  # noqa: E731
             feat, dtype=cfg.dtype, name=name,
             kernel_init=nn.initializers.normal(0.02),
+            dot_general_cls=fp8_dot_general_cls(cfg.fp8),
         )
 
         y = nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name="ln_1")(x)
@@ -251,7 +258,7 @@ class GPT2(nn.Module):
             block_cls = remat_block(Block, cfg.remat, in_scan=True)
             blocks = nn.scan(
                 block_cls,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "fp8": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast, nn.broadcast),
                 length=cfg.n_layer,

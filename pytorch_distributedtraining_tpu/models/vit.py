@@ -18,6 +18,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
+from ..precision import fp8_dot_general_cls
 from .gpt2 import default_attention
 from .scan_utils import remat_block
 
@@ -38,6 +39,10 @@ class ViTConfig:
     # nn.scan over the encoder stack: one compiled block, params stacked
     # under "encoder" (vs per-layer "encoder_{i}"); see models/scan_utils.py
     scan_layers: bool = False
+    # Narrow the encoder Dense matmuls to fp8 operands ("e4m3"/"e5m2"
+    # forward dtype); amax histories live in the "fp8" collection. The
+    # patch-embed conv and classifier head stay at cfg.dtype.
+    fp8: str | None = None
 
     @staticmethod
     def b16() -> "ViTConfig":
@@ -63,7 +68,8 @@ class EncoderBlock(nn.Module):
         cfg = self.cfg
         d, h = cfg.hidden_dim, cfg.num_heads
         dense = partial(nn.Dense, dtype=cfg.dtype,
-                        kernel_init=nn.initializers.xavier_uniform())
+                        kernel_init=nn.initializers.xavier_uniform(),
+                        dot_general_cls=fp8_dot_general_cls(cfg.fp8))
 
         y = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
         qkv = dense(3 * d, name="c_attn")(y)
@@ -120,7 +126,7 @@ class ViT(nn.Module):
             block_cls = remat_block(EncoderBlock, cfg.remat, in_scan=True)
             blocks = nn.scan(
                 block_cls,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "fp8": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast,),
                 length=cfg.num_layers,

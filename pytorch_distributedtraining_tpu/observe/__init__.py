@@ -8,11 +8,13 @@ per-step timing.
 
 from . import wandb_compat as wandb
 from .hlo import (
+    WIRE_NARROW_DTYPES,
     CollectiveOp,
     HloInstruction,
     OverlapAudit,
     OverlapFinding,
     PipelineAudit,
+    WireCollective,
     collective_inventory,
     collectives_schedulable,
     counts,
@@ -21,6 +23,7 @@ from .hlo import (
     overlap_audit,
     pipeline_audit,
     tokenize_hlo,
+    wire_inventory,
 )
 from .memory import (
     MemoryStats,
@@ -45,6 +48,9 @@ __all__ = [
     "HloInstruction",
     "tokenize_hlo",
     "collective_inventory",
+    "WireCollective",
+    "wire_inventory",
+    "WIRE_NARROW_DTYPES",
     "counts",
     "has_logical_reduce_scatter",
     "max_all_reduce_elems",

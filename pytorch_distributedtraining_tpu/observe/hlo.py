@@ -174,6 +174,57 @@ def collective_inventory(hlo_text: str) -> list[CollectiveOp]:
     return out
 
 
+# Result-type tokens a quantized gradient collective may carry on the
+# wire. ``f16`` is here with a caveat: XLA:CPU's float-support
+# legalization rewrites f8 collectives to f16 (the same backend behavior
+# :func:`has_logical_reduce_scatter` documents for its pattern), so on
+# the CPU test backend an fp8 wire shows up as f16 — on TPU the f8
+# dtypes appear directly. bf16 is deliberately NOT narrow: nothing in
+# the quantized transport emits it, so a bf16 grad collective means the
+# wire format silently fell back to plain mixed-precision traffic.
+WIRE_NARROW_DTYPES = frozenset(
+    {"s8", "u8", "f8e4m3fn", "f8e4m3", "f8e4m3b11fnuz", "f8e5m2", "f16"}
+)
+
+_DTYPE_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+@dataclass(frozen=True)
+class WireCollective:
+    """One collective with its wire dtype and total payload elements."""
+
+    kind: str    # all-reduce | reduce-scatter | all-gather | all-to-all | ...
+    dtype: str   # result dtype token ("s8", "f16", "f32", "f8e4m3fn", ...)
+    elems: int   # total result elements (tuple members SUMMED, not maxed)
+    line: str    # the HLO instruction text, for debugging failed asserts
+
+    def __repr__(self) -> str:  # keep pytest output readable
+        return f"WireCollective({self.kind}, {self.dtype}, {self.elems})"
+
+
+def wire_inventory(hlo_text: str) -> list[WireCollective]:
+    """Parse a module's collectives with their wire dtypes.
+
+    The dtype comes from the *result* type left of the op token — for a
+    tuple-shaped result (XLA:CPU decomposes ``all-to-all`` into one tuple
+    member per peer) every member shares the dtype and ``elems`` sums
+    them, so ``elems * itemsize`` approximates the bytes the op moves per
+    partition. The bytes-on-wire audit
+    (``analyze.hlo_rules.wire_backoff``) is built on this inventory.
+    """
+    out = []
+    for ins in tokenize_hlo(hlo_text):
+        m = _OP_RE.search(ins.text)
+        if m is None:
+            continue
+        lhs = ins.text.split(m.group(0), 1)[0]
+        groups = _DTYPE_SHAPE_RE.findall(lhs)
+        dtype = groups[0][0] if groups else ""
+        elems = sum(_elems(g) for _, g in groups) if groups else 1
+        out.append(WireCollective(m.group(1), dtype, elems, ins.text))
+    return out
+
+
 def max_all_reduce_elems(hlo_text: str) -> int:
     """Largest all-reduce result in the module (0 when none).
 

@@ -31,7 +31,12 @@ from .remat import (
 from .spec import leaf_spec, tree_specs, shard_axis
 from .state import TrainState, create_train_state
 from .step import TrainStep, EvalStep, MultiStep, tune_multi_step_k
-from .compressed import CompressedGradStep
+from .compressed import (
+    WIRE_FORMATS,
+    CompressedGradStep,
+    WireFormat,
+    wire_format,
+)
 from .tensor import MEGATRON_RULES, TensorParallel, tp_zero1, tp_zero3
 from .pipeline import (
     SCHEDULES,
@@ -70,6 +75,9 @@ __all__ = [
     "MultiStep",
     "tune_multi_step_k",
     "CompressedGradStep",
+    "WIRE_FORMATS",
+    "WireFormat",
+    "wire_format",
     "MEGATRON_RULES",
     "TensorParallel",
     "tp_zero1",

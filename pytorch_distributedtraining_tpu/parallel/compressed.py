@@ -1,34 +1,57 @@
-"""Quantized-gradient data parallelism: int8 all-reduce with error feedback.
+"""Quantized-gradient data parallelism: low-precision wire formats with
+error feedback.
 
 Extends the reference's wire-compression idea (fp16 OSS broadcast,
-`/root/reference/Stoke-DDP.py:197-199`) to the gradient all-reduce itself,
+`/root/reference/Stoke-DDP.py:197-199`) to the gradient reduction itself,
 the direction EQuARX takes inside XLA (PAPERS.md): on bandwidth-limited
 links (DCN between slices, large pods) the grad all-reduce dominates step
-time, and 8-bit wire traffic quarters it.
+time, and an 8-bit wire quarters it.
 
-Design (per gradient leaf, per step):
-  1. add the previous step's quantization residual (error feedback — keeps
-     the compression UNBIASED over time; plain int8 rounding stalls
-     convergence),
-  2. per-leaf symmetric quantization: scale = max|g| / 127 on each shard,
-     all-reduced with ``pmax`` so every shard uses the SAME scale (sums of
-     int8 payloads then dequantize exactly),
-  3. int32 reduction of the int8 payload over the compressed axis (sum of
-     world_size int8 values needs ~15 bits of headroom — int32 psum; XLA
-     keeps the wire payload at the narrow width). With a ZeRO-2 policy the
-     reduction is a ``psum_scatter`` straight to the owning shard — the
-     quantized twin of ShardedDDP's reduce-to-owner hooks
-     (`Fairscale-DDP.py:89`),
-  4. dequantize to f32 mean-gradient; store the new residual
-     ``g_local - dequant(q_local)`` for the next step.
+Wire formats are pluggable (:data:`WIRE_FORMATS`): per-tensor int8,
+block-scaled int8, and block-scaled fp8 (e4m3 / e5m2). Each leaf rides
+the wire as ``(payload, scales)`` where the payload is the narrow dtype
+and scales are one fp32 per tensor (per-tensor) or per ``block`` elements
+(block-scaled, ~1.5% overhead at the default block of 256, but robust to
+outlier blocks that would otherwise flatten the rest of the tensor).
+
+Transport (per gradient leaf, per step):
+
+  1. add the previous step's quantization residual (error feedback —
+     keeps the compression UNBIASED over time; plain 8-bit rounding
+     stalls convergence),
+  2. lay the leaf out as ``[W, L]`` rows — row ``i`` is the slice shard
+     ``i`` will own after the reduction (the ZeRO-2 scatter chunk, or an
+     even split of the flattened leaf for a full all-reduce), padded with
+     zeros to the block boundary,
+  3. encode rows locally and ``all_to_all`` payload + scales over the
+     compressed axis: each shard receives every peer's encoded
+     contribution *to its own chunk*, dequantizes with the sender's
+     scales, and sums in f32. This is the reduce-scatter decomposition
+     that provably keeps the narrow dtype on the wire — a plain
+     ``psum(int8.astype(int32))`` compiles to an s32 all-reduce, 4x the
+     bytes (`analyze.hlo_rules.wire_backoff` audits the compiled HLO for
+     exactly this),
+  4. ZeRO-2 stops here (reduce-to-owner, the quantized twin of
+     ShardedDDP's hooks, `Fairscale-DDP.py:89`). The full all-reduce
+     re-encodes the reduced chunk and ``all_gather``\\ s it — a second
+     narrow hop whose requantization error is half an ulp of the *mean*
+     gradient (accepted, not error-fed: it is not observable per-shard),
+  5. the new residual ``x - decode(encode(x))`` is stored in the param's
+     own dtype for the next step.
+
+Leaves with fewer than ``min_wire_elems`` elements stay on the plain f32
+``psum``/``psum_scatter`` (biases and norm scales are latency-bound, not
+bandwidth-bound — quantizing them buys nothing and costs accuracy).
 
 ``CompressedGradStep`` is an opt-in TrainStep sibling: same
-``loss_fn(params, batch, rng, model_state) -> (loss, aux)`` contract, same
-optimizer update semantics. Composition surface (VERDICT r3 weak #6):
+``loss_fn(params, batch, rng, model_state) -> (loss, aux)`` contract,
+same optimizer update semantics, same ``lr_factor`` / ``compiled_text``
+surface (so the facade and ``graftcheck`` drive it interchangeably).
+Composition surface:
 
-- **policy**: ``DDP`` (default — int8 psum, replicated grads), ``ZeRO1``
-  (same wire format; the sharded opt state rides create_train_state), or
-  ``ZeRO2`` (int8 **psum_scatter**: each shard receives only its owned
+- **policy**: ``DDP`` (default — narrow all-reduce, replicated grads),
+  ``ZeRO1`` (same wire; the sharded opt state rides create_train_state),
+  or ``ZeRO2`` (narrow reduce-scatter: each shard receives only its owned
   grad slice, wire volume 1/n of the all-reduce on top of the 4x width
   win). ``ZeRO3`` is rejected: sharded params need per-block gather
   scheduling that belongs to ``TrainStep``.
@@ -40,13 +63,14 @@ optimizer update semantics. Composition surface (VERDICT r3 weak #6):
 
 The grad collectives run inside ``shard_map`` (the implicit psum of the
 jit path cannot be intercepted for quantization); ``check_vma=False``
-keeps grads local per shard, and the quantized reduction/axis-size IS the
-mean.
+keeps grads local per shard, and the reduction/axis-size IS the mean.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -60,13 +84,136 @@ from .policy import DDP, Policy
 from .spec import leaf_spec
 from .state import TrainState
 
+# Floor on the quantization scale. An all-zero leaf (or block) has
+# amax 0; the scale must stay strictly positive so ``x / scale`` is
+# finite and decodes back to exact zeros (pinned by
+# test_quantize_all_zero_leaf_is_exact).
+SCALE_EPS = 1e-12
+
+# Leaves below this many elements ride the plain f32 collective: the
+# payload is latency-bound there and block-scale overhead would eat the
+# width win. Mirrors the spirit of analyze.hlo_rules.BACKOFF_MIN_LEAF_ELEMS.
+MIN_WIRE_ELEMS = 2048
+
+DEFAULT_BLOCK = 256
+
+
+@dataclass(frozen=True)
+class WireFormat:
+    """One low-precision gradient wire encoding.
+
+    ``payload_dtype`` is what the collectives carry; ``block`` is the
+    number of elements sharing one fp32 scale (``None`` = one scale per
+    tensor). ``encode``/``decode`` operate on ``[rows, L]`` layouts where
+    ``L`` is a multiple of ``block`` — the transport owns padding.
+    """
+
+    name: str
+    payload_dtype: Any
+    block: int | None = None
+    min_wire_elems: int = MIN_WIRE_ELEMS
+
+    @property
+    def qmax(self) -> float:
+        """Largest representable magnitude of the payload dtype."""
+        if jnp.issubdtype(jnp.dtype(self.payload_dtype), jnp.integer):
+            return float(jnp.iinfo(self.payload_dtype).max)
+        return float(jnp.finfo(self.payload_dtype).max)
+
+    @property
+    def bits(self) -> int:
+        return jnp.dtype(self.payload_dtype).itemsize * 8
+
+    def scale_count(self, row_elems: int) -> int:
+        """fp32 scales per row of ``row_elems`` (block-padded) elements."""
+        if self.block is None:
+            return 1
+        return max(1, row_elems // self.block)
+
+    def encode(self, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """``[W, L]`` f32 -> (payload ``[W, L]`` narrow, scales ``[W, S]``)."""
+        w, l = x.shape
+        x = x.astype(jnp.float32)
+        if self.block is None:
+            blocks = x.reshape(w, 1, l)
+        else:
+            blocks = x.reshape(w, l // self.block, self.block)
+        amax = jnp.max(jnp.abs(blocks), axis=-1)
+        scales = jnp.maximum(amax / self.qmax, SCALE_EPS)
+        y = blocks / scales[..., None]
+        if jnp.issubdtype(jnp.dtype(self.payload_dtype), jnp.integer):
+            q = jnp.round(y)
+        else:
+            q = y
+        q = jnp.clip(q, -self.qmax, self.qmax).astype(self.payload_dtype)
+        return q.reshape(w, l), scales.astype(jnp.float32)
+
+    def decode(self, payload: jax.Array, scales: jax.Array) -> jax.Array:
+        """Inverse of :meth:`encode`, back to ``[W, L]`` f32."""
+        w, l = payload.shape
+        s = scales.shape[1]
+        blocks = payload.astype(jnp.float32).reshape(w, s, l // s)
+        return (blocks * scales[..., None]).reshape(w, l)
+
+
+WIRE_FORMATS: dict[str, WireFormat] = {
+    "int8": WireFormat("int8", jnp.int8, block=None),
+    "int8_block": WireFormat("int8_block", jnp.int8, block=DEFAULT_BLOCK),
+    "fp8_e4m3": WireFormat(
+        "fp8_e4m3", jnp.float8_e4m3fn, block=DEFAULT_BLOCK
+    ),
+    "fp8_e5m2": WireFormat(
+        "fp8_e5m2", jnp.float8_e5m2, block=DEFAULT_BLOCK
+    ),
+}
+
+_OFF = ("", "off", "none", "fp32", "0", "false")
+
+
+def wire_format(spec: "str | WireFormat | None") -> WireFormat | None:
+    """Resolve a wire-format spelling to a :class:`WireFormat`.
+
+    Accepts a registry name (``"int8_block"``), a ``name:block`` override
+    (``"fp8_e4m3:128"``), an already-built :class:`WireFormat`, or an
+    off-spelling (``None`` / ``"off"`` / ``"fp32"``) -> ``None``.
+    """
+    if spec is None or isinstance(spec, WireFormat):
+        return spec
+    s = str(spec).strip().lower()
+    if s in _OFF:
+        return None
+    name, _, blk = s.partition(":")
+    if name not in WIRE_FORMATS:
+        raise ValueError(
+            f"unknown wire format {name!r}: expected one of "
+            f"{sorted(WIRE_FORMATS)} (optionally name:block), or 'off'"
+        )
+    fmt = WIRE_FORMATS[name]
+    if blk:
+        if fmt.block is None:
+            raise ValueError(
+                f"wire format {name!r} is per-tensor scaled; a block size "
+                f"({blk!r}) does not apply"
+            )
+        b = int(blk)
+        if b <= 0:
+            raise ValueError(f"wire block size must be positive, got {b}")
+        fmt = dataclasses.replace(fmt, block=b)
+    return fmt
+
 
 def _quantize(g, residual, axis_name):
-    """(g + residual) -> (int8 payload, shared scale, new residual)."""
+    """(g + residual) -> (int8 payload, shared scale, new residual).
+
+    Legacy per-tensor helper retained for the unbiasedness pin test: one
+    scale per leaf, shared across the axis with ``pmax`` so int8 payloads
+    sum exactly. The scale floor is :data:`SCALE_EPS` — an all-zero leaf
+    quantizes to zeros with a zero residual instead of dividing by zero.
+    """
     g = g.astype(jnp.float32) + residual
     local_max = jnp.max(jnp.abs(g))
     scale = lax.pmax(local_max, axis_name) / 127.0
-    safe = jnp.maximum(scale, 1e-12)
+    safe = jnp.maximum(scale, SCALE_EPS)
     q = jnp.clip(jnp.round(g / safe), -127, 127).astype(jnp.int8)
     new_residual = g - q.astype(jnp.float32) * safe
     return q, safe, new_residual
@@ -82,14 +229,16 @@ def _scatter_dim(spec: P, axis_name: str) -> int | None:
 
 
 class CompressedGradStep:
-    """Train step whose gradient reduction rides an int8 wire format.
+    """Train step whose gradient reduction rides a narrow wire format.
 
-    Opt-in sibling of ``TrainStep``. Residual state for error feedback is
-    PER-SHARD — stored with leading mesh axes ``[dp(, fsdp), ...]``
-    sharded over them in ``TrainState.model_state['grad_residual']``
-    (auto-initialized on first call); each shard's residual tracks its own
-    local quantization error on exactly the tensor it quantizes (the full
-    leaf, or its fsdp-owned slice on a hybrid mesh).
+    Opt-in sibling of ``TrainStep``. ``wire`` picks the encoding (any
+    :func:`wire_format` spelling; default per-tensor ``"int8"``).
+    Residual state for error feedback is PER-SHARD — stored with leading
+    mesh axes ``[dp(, fsdp), ...]`` sharded over them in
+    ``TrainState.model_state['grad_residual']`` (auto-initialized on
+    first call); each shard's residual tracks its own local quantization
+    error on exactly the tensor it quantizes (the full leaf, or its
+    fsdp-owned slice on a hybrid mesh), in the param's own dtype.
     """
 
     def __init__(
@@ -101,6 +250,7 @@ class CompressedGradStep:
         *,
         axis_name: str = "dp",
         donate: bool = False,
+        wire: "str | WireFormat | None" = "int8",
     ):
         policy = policy or DDP()
         if policy.shard_params:
@@ -121,16 +271,32 @@ class CompressedGradStep:
                 f"unsupported data-axis layout {axes}: expected pure "
                 f"({axis_name!r},) or hybrid ({axis_name!r}, 'fsdp')"
             )
+        fmt = wire_format(wire)
+        if fmt is None:
+            raise ValueError(
+                "CompressedGradStep needs a wire format — for a plain f32 "
+                "wire use TrainStep"
+            )
+        if not hasattr(tx, "update"):
+            # optim.FusedAdamW ravels grads into one flat vector; the
+            # quantized wire is per-leaf (block scales follow leaf shape)
+            raise ValueError(
+                f"{type(tx).__name__} has no optax-style .update — the "
+                "quantized wire is a per-leaf path; use optim.adamw (the "
+                "tree chain) with CompressedGradStep"
+            )
         self.loss_fn = loss_fn
         self.tx = tx
         self.mesh = mesh
         self.policy = policy
+        self.wire = fmt
         self.axis_name = axis_name
         self.ici_axis = extra[0] if extra else None
         # ZeRO grads shard over fsdp when present, else over dp itself;
         # that axis also decides where the quantized scatter lands
         self._zaxis = self.ici_axis or axis_name
         self._zsize = mesh.shape[self._zaxis]
+        self._wsize = mesh.shape[axis_name]  # width of the quantized hop
         self.n_data_shards = 1
         for a in axes:
             self.n_data_shards *= mesh.shape[a]
@@ -162,9 +328,69 @@ class CompressedGradStep:
         out[d] //= self._zsize
         return tuple(out)
 
+    def _on_wire(self, shape, spec: P) -> bool:
+        """Whether this leaf's dp reduction is quantized (size floor, and
+        the ZeRO-2 row layout needs the scatter dim to split W ways)."""
+        n = 1
+        for s in self._quant_shape(shape):
+            n *= s
+        if n < self.wire.min_wire_elems:
+            return False
+        d = None if self.ici_axis is not None else _scatter_dim(spec, self.axis_name)
+        if d is not None and shape[d] % self._wsize:
+            return False
+        return True
+
+    def wire_cost(self, params) -> dict:
+        """Analytic bytes-on-wire accounting for the dp hop of one step.
+
+        Returns ``{"wire_format", "wire_bytes", "fp32_bytes",
+        "wire_fraction_quantized"}`` where ``wire_bytes`` counts payload +
+        scale bytes each shard sends on the quantized hop(s) and
+        ``fp32_bytes`` is what the same leaves would cost uncompressed.
+        Floored leaves are charged at f32 width in both columns.
+        """
+        fmt = self.wire
+        wire = fp32 = quantized = total = 0
+        for p in jax.tree.leaves(params):
+            spec = self._grad_spec(p.shape)
+            shape = self._quant_shape(p.shape)
+            n = 1
+            for s in shape:
+                n *= s
+            total += n
+            # bytes each shard moves for this leaf on the dp hop: a
+            # reduce-scatter sends n, an all-reduce sends 2n (reduce +
+            # gather hops)
+            d = (
+                None
+                if self.ici_axis is not None
+                else _scatter_dim(spec, self.axis_name)
+            )
+            hops = 1 if d is not None else 2
+            fp32 += hops * n * 4
+            if not self._on_wire(p.shape, spec):
+                wire += hops * n * 4
+                continue
+            quantized += n
+            blk = fmt.block or n
+            nblocks = -(-n // blk)
+            payload = nblocks * blk * jnp.dtype(fmt.payload_dtype).itemsize
+            scales = fmt.scale_count(nblocks * blk) * 4
+            wire += hops * (payload + scales)
+        return {
+            "wire_format": fmt.name
+            + (f":{fmt.block}" if fmt.block not in (None, DEFAULT_BLOCK) else ""),
+            "wire_bytes": int(wire),
+            "fp32_bytes": int(fp32),
+            "wire_fraction_quantized": (quantized / total) if total else 0.0,
+        }
+
     def init_residuals(self, params):
         """Zero per-shard error-feedback residuals, leading mesh axes
-        ``[dp(, fsdp)]`` sharded so each shard owns its own residual."""
+        ``[dp(, fsdp)]`` sharded so each shard owns its own residual.
+        Residual dtype follows the param dtype (a bf16 model should not
+        pay f32 residual memory)."""
         from jax.sharding import NamedSharding
 
         lead_axes = (self.axis_name,) + (
@@ -174,7 +400,7 @@ class CompressedGradStep:
         sh = NamedSharding(self.mesh, P(*lead_axes))
         return jax.tree.map(
             lambda p: jax.device_put(
-                jnp.zeros(lead_shape + self._quant_shape(p.shape), jnp.float32),
+                jnp.zeros(lead_shape + self._quant_shape(p.shape), p.dtype),
                 sh,
             ),
             params,
@@ -183,8 +409,10 @@ class CompressedGradStep:
     # -- the step ----------------------------------------------------------
 
     def _reduce_one(self, g, r, spec: P):
-        """One leaf: (ICI f32 reduce) -> error feedback -> int8 dp reduce."""
+        """One leaf: (ICI f32 reduce) -> error feedback -> narrow dp wire."""
         dp = self.axis_name
+        fmt = self.wire
+        shape = g.shape
         if self.ici_axis is not None:
             d = _scatter_dim(spec, self.ici_axis)
             if d is not None:  # scatter to owner on the fast links, f32
@@ -193,18 +421,65 @@ class CompressedGradStep:
                 )
             else:
                 g = lax.psum(g, self.ici_axis)
-        q, scale, new_r = _quantize(g, r, dp)
         d = None if self.ici_axis is not None else _scatter_dim(spec, dp)
-        if d is not None:  # quantized reduce-to-owner (ZeRO-2, pure dp)
-            total = lax.psum_scatter(
-                q.astype(jnp.int32), dp, scatter_dimension=d, tiled=True
-            )
+        if not self._on_wire(shape, spec):
+            # floored: plain f32 collective, residual passes through
+            if d is not None:
+                total = lax.psum_scatter(
+                    g, dp, scatter_dimension=d, tiled=True
+                )
+            else:
+                total = lax.psum(g, dp)
+            return total / self.n_data_shards, r
+
+        w = self._wsize
+        x = g.astype(jnp.float32) + r.astype(jnp.float32)
+        blk = fmt.block or 1
+        if d is not None:
+            # ZeRO-2 rows: row i is exactly the dim-d chunk shard i owns
+            moved = jnp.moveaxis(x, d, 0)
+            rows = moved.reshape(w, -1)
+            pad = (-rows.shape[1]) % blk
+            rows = jnp.pad(rows, ((0, 0), (0, pad)))
+
+            def restore(t):  # [w, L] -> local leaf shape
+                t = t[:, : t.shape[1] - pad] if pad else t
+                return jnp.moveaxis(t.reshape(moved.shape), 0, d)
+
         else:
-            total = lax.psum(q.astype(jnp.int32), dp)
-        mean = total.astype(jnp.float32) * scale / self.n_data_shards
+            # all-reduce rows: even split of the flattened leaf
+            flat = x.reshape(-1)
+            pad = (-flat.size) % (w * blk)
+            rows = jnp.pad(flat, (0, pad)).reshape(w, -1)
+
+            def restore(t):  # [w, L] -> local leaf shape
+                t = t.reshape(-1)
+                t = t[: t.size - pad] if pad else t
+                return t.reshape(x.shape)
+
+        payload, scales = fmt.encode(rows)
+        # error feedback: what encode lost locally feeds the next step
+        new_r = restore(rows - fmt.decode(payload, scales)).astype(r.dtype)
+        # reduce-scatter = all_to_all + local dequant-sum: shard i receives
+        # every peer's encoded chunk i WITH the peer's scales — narrow
+        # payload on the wire, exact f32 accumulation on chip
+        p_recv = lax.all_to_all(payload, dp, split_axis=0, concat_axis=0)
+        s_recv = lax.all_to_all(scales, dp, split_axis=0, concat_axis=0)
+        chunk = jnp.sum(fmt.decode(p_recv, s_recv), axis=0)
+        chunk = chunk / self.n_data_shards  # [L]: the mean of my chunk
+        if d is not None:
+            out = chunk[: chunk.size - pad] if pad else chunk
+            owner = list(moved.shape)
+            owner[0] //= w
+            return jnp.moveaxis(out.reshape(owner), 0, d), new_r
+        # full all-reduce: re-encode the reduced chunk and gather narrow
+        p2, s2 = fmt.encode(chunk[None])
+        gp = lax.all_gather(p2[0], dp, axis=0, tiled=True)
+        gs = lax.all_gather(s2, dp, axis=0, tiled=True)
+        mean = restore(fmt.decode(gp.reshape(w, -1), gs))
         return mean, new_r
 
-    def _step(self, state: TrainState, batch):
+    def _step(self, state: TrainState, batch, lr_factor):
         rng = jax.random.fold_in(state.rng, state.step)
         residuals = state.model_state["grad_residual"]
         extra_state = {
@@ -260,6 +535,7 @@ class CompressedGradStep:
         )(state.params, residuals, batch)
 
         updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+        updates = jax.tree.map(lambda u: u * lr_factor, updates)
         new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
             step=state.step + 1,
@@ -269,12 +545,46 @@ class CompressedGradStep:
         )
         return new_state, {"loss": loss.astype(jnp.float32)}
 
-    def __call__(self, state: TrainState, batch):
-        if "grad_residual" not in state.model_state:
-            state = state.replace(
-                model_state={
-                    **state.model_state,
-                    "grad_residual": self.init_residuals(state.params),
-                }
+    def _with_residuals(self, state: TrainState) -> TrainState:
+        if "grad_residual" in state.model_state:
+            return state
+        return state.replace(
+            model_state={
+                **state.model_state,
+                "grad_residual": self.init_residuals(state.params),
+            }
+        )
+
+    # -- AOT surface (mirrors TrainStep so analyze/facade drive either) ----
+
+    def precompile(self, state: TrainState, batch, lr_factor: float = 1.0):
+        """Compile the step without executing it (see TrainStep.precompile)."""
+        state = self._with_residuals(state)
+        with self.mesh:
+            self._jitted.lower(state, batch, jnp.float32(lr_factor)).compile()
+
+    def compiled_text(self, state: TrainState, batch, lr_factor: float = 1.0):
+        """Compiled HLO of this step, for `observe.hlo` collective audits
+        (prove the wire actually carries the narrow dtype)."""
+        state = self._with_residuals(state)
+        with self.mesh:
+            return (
+                self._jitted.lower(state, batch, jnp.float32(lr_factor))
+                .compile()
+                .as_text()
             )
-        return self._jitted(state, batch)
+
+    def memory_analysis(self, state: TrainState, batch, lr_factor: float = 1.0):
+        """Compiler memory accounting for this step (`observe.memory`)."""
+        from ..observe.memory import compiled_memory_stats
+
+        state = self._with_residuals(state)
+        with self.mesh:
+            compiled = self._jitted.lower(
+                state, batch, jnp.float32(lr_factor)
+            ).compile()
+        return compiled_memory_stats(compiled)
+
+    def __call__(self, state: TrainState, batch, lr_factor: float = 1.0):
+        state = self._with_residuals(state)
+        return self._jitted(state, batch, jnp.float32(lr_factor))

@@ -1,4 +1,4 @@
-"""Mixed precision: bf16 compute policy + fp16 dynamic loss scaler.
+"""Mixed precision: bf16 compute policy, fp8 matmuls, fp16 loss scaler.
 
 TPU-native precision story: **bf16 compute, f32 params/optimizer state, no
 loss scaling needed** (bf16 shares f32's exponent range). The fp16
@@ -7,15 +7,38 @@ GradScaler path exists for API parity with the reference's
 impl `torch/amp/grad_scaler.py:53`) and for the rare fp16 deployment; it is
 a pure pytree so the whole scale/unscale/skip-on-overflow dance stays inside
 the compiled step (torch round-trips to host for ``scaler.update()``).
+
+The fp8 matmul path (:class:`Fp8DotGeneral`, transformer-engine-style
+delayed scaling) narrows tagged ``dot_general``\\ s — the Dense trunks of
+GPT-2 and ViT — to 8-bit operands with f32 accumulation:
+
+- **forward**: operands quantize to ``e4m3`` with a *delayed* scale — the
+  running amax history of the last ``history_len`` steps, stored in the
+  ``"fp8"`` variable collection (rides ``TrainState.model_state`` exactly
+  like batch stats; a fresh all-zero history falls back to the current
+  amax so step 0 is still well-scaled),
+- **backward**: the cotangent quantizes to ``e5m2`` (wider exponent — grad
+  outliers) with a just-in-time scale, so no mutable state is needed in
+  the backward pass; both transposed matmuls run with fp8 operands too,
+- scales are treated as constants by autodiff (zero cotangent), the
+  standard delayed-scaling recipe.
+
+Composes with the loss scaler (scaling happens on the f32 loss, outside
+the narrowed dots), remat (the module is pure given its collections), and
+``nn.scan`` over layers (stack the ``"fp8"`` collection with
+``variable_axes={"fp8": 0}``).
 """
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from flax import struct
+from jax import lax
 
 
 _DTYPES = {
@@ -39,12 +62,16 @@ class Policy:
     """jmp-style three-dtype policy.
 
     ``param_dtype`` — storage; ``compute_dtype`` — matmul/conv inputs (bf16
-    feeds the MXU at full rate); ``output_dtype`` — loss/outputs.
+    feeds the MXU at full rate); ``output_dtype`` — loss/outputs. ``fp8``
+    additionally narrows tagged matmuls to 8-bit operands ("e4m3" or
+    "e5m2" forward dtype; see :class:`Fp8DotGeneral`) — models opt in by
+    passing :func:`fp8_dot_general_cls` to their Dense layers.
     """
 
     param_dtype: object = jnp.float32
     compute_dtype: object = jnp.float32
     output_dtype: object = jnp.float32
+    fp8: str | None = None
 
     @staticmethod
     def from_name(name: str | None) -> "Policy":
@@ -54,6 +81,10 @@ class Policy:
             return Policy(compute_dtype=jnp.bfloat16)
         if name in ("fp16", "float16", "amp"):
             return Policy(compute_dtype=jnp.float16)
+        if name in ("fp8", "fp8_e4m3"):
+            return Policy(compute_dtype=jnp.bfloat16, fp8="e4m3")
+        if name == "fp8_e5m2":
+            return Policy(compute_dtype=jnp.bfloat16, fp8="e5m2")
         raise ValueError(f"unknown precision policy {name!r}")
 
     def cast_to_compute(self, tree):
@@ -132,3 +163,162 @@ class DynamicLossScaler:
             finite, jnp.where(grew, 0, state.growth_count + 1), 0
         ).astype(jnp.int32)
         return ScalerState(scale=new_scale, growth_count=new_count)
+
+
+# -- fp8 matmul path ---------------------------------------------------------
+
+FP8_DTYPES = {"e4m3": jnp.float8_e4m3fn, "e5m2": jnp.float8_e5m2}
+
+# Scale floor: an all-zero operand must quantize to zeros, not divide by 0.
+_FP8_SCALE_EPS = 1e-12
+
+
+def _fp8_max(dtype) -> float:
+    return float(jnp.finfo(dtype).max)
+
+
+def _to_fp8(x, scale, dtype):
+    m = _fp8_max(dtype)
+    return jnp.clip(x.astype(jnp.float32) / scale, -m, m).astype(dtype)
+
+
+def _check_dense_dn(lhs_ndim, rhs_ndim, dimension_numbers):
+    (lc, rc), (lb, rb) = dimension_numbers
+    if (
+        lb
+        or rb
+        or len(lc) != 1
+        or len(rc) != 1
+        or lc[0] != lhs_ndim - 1
+        or rc[0] != 0
+        or rhs_ndim != 2
+    ):
+        raise NotImplementedError(
+            "fp8_dot_general covers the Dense contraction "
+            "([..., K] x [K, N], no batch dims); got "
+            f"dimension_numbers={dimension_numbers} with lhs rank {lhs_ndim}, "
+            f"rhs rank {rhs_ndim}"
+        )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fp8_dot_general(lhs, rhs, s_lhs, s_rhs, dimension_numbers, fwd_dtype):
+    """``dot_general`` with fp8 operands and f32 accumulation.
+
+    ``s_lhs``/``s_rhs`` are f32 scalar scales (amax / dtype-max); autodiff
+    treats them as constants. Forward quantizes both operands to
+    ``fwd_dtype``; backward quantizes the cotangent to e5m2 with a
+    just-in-time scale and keeps fp8 operands on both transposed matmuls.
+    """
+    _check_dense_dn(lhs.ndim, rhs.ndim, dimension_numbers)
+    ql = _to_fp8(lhs, s_lhs, fwd_dtype)
+    qr = _to_fp8(rhs, s_rhs, fwd_dtype)
+    out = lax.dot_general(
+        ql, qr, dimension_numbers, preferred_element_type=jnp.float32
+    )
+    return out * (s_lhs * s_rhs)
+
+
+def _fp8_dot_fwd(lhs, rhs, s_lhs, s_rhs, dimension_numbers, fwd_dtype):
+    out = fp8_dot_general(lhs, rhs, s_lhs, s_rhs, dimension_numbers, fwd_dtype)
+    return out, (lhs, rhs, s_lhs, s_rhs)
+
+
+def _fp8_dot_bwd(dimension_numbers, fwd_dtype, res, g):
+    lhs, rhs, s_l, s_r = res
+    ql = _to_fp8(lhs, s_l, fwd_dtype)
+    qr = _to_fp8(rhs, s_r, fwd_dtype)
+    # e5m2 for the cotangent: gradients carry outliers, exponent range
+    # matters more than mantissa. Just-in-time scale — no state in bwd.
+    e5m2 = jnp.float8_e5m2
+    s_g = jnp.maximum(
+        jnp.max(jnp.abs(g)).astype(jnp.float32) / _fp8_max(e5m2),
+        _FP8_SCALE_EPS,
+    )
+    qg = _to_fp8(g, s_g, e5m2)
+    # dL/dlhs = g . rhs^T : [..., N] x [K, N] -> [..., K]
+    dl = lax.dot_general(
+        qg, qr, (((g.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * (s_g * s_r)
+    # dL/drhs = lhs^T . g : contract every leading dim -> [K, N]
+    lead_l = tuple(range(lhs.ndim - 1))
+    lead_g = tuple(range(g.ndim - 1))
+    dr = lax.dot_general(
+        ql, qg, ((lead_l, lead_g), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * (s_l * s_g)
+    return (
+        dl.astype(lhs.dtype),
+        dr.astype(rhs.dtype),
+        jnp.zeros_like(s_l),
+        jnp.zeros_like(s_r),
+    )
+
+
+fp8_dot_general.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
+
+
+class Fp8DotGeneral(nn.Module):
+    """Drop-in ``dot_general`` module for ``nn.Dense(dot_general_cls=...)``.
+
+    Holds per-matmul amax histories in the ``"fp8"`` variable collection
+    (delayed scaling): the forward scale is the max of the last
+    ``history_len`` observed amaxes, refreshed each training step (any
+    step where the ``"fp8"`` collection is mutable). A fresh history falls
+    back to the current amax, so evaluation-before-training and step 0
+    are still well-scaled.
+    """
+
+    fwd_dtype: str = "e4m3"
+    history_len: int = 16
+
+    @nn.compact
+    def __call__(
+        self,
+        lhs,
+        rhs,
+        dimension_numbers,
+        precision=None,
+        preferred_element_type=None,
+    ):
+        del precision, preferred_element_type  # fp8 path fixes both
+        dt = FP8_DTYPES[self.fwd_dtype]
+        hist_l = self.variable(
+            "fp8", "amax_lhs", jnp.zeros, (self.history_len,), jnp.float32
+        )
+        hist_r = self.variable(
+            "fp8", "amax_rhs", jnp.zeros, (self.history_len,), jnp.float32
+        )
+        a_l = jnp.max(jnp.abs(lhs)).astype(jnp.float32)
+        a_r = jnp.max(jnp.abs(rhs)).astype(jnp.float32)
+        h_l = jnp.max(hist_l.value)
+        h_r = jnp.max(hist_r.value)
+        eff_l = jnp.where(h_l > 0, h_l, a_l)
+        eff_r = jnp.where(h_r > 0, h_r, a_r)
+        m = _fp8_max(dt)
+        s_l = jnp.maximum(eff_l / m, _FP8_SCALE_EPS)
+        s_r = jnp.maximum(eff_r / m, _FP8_SCALE_EPS)
+        if self.is_mutable_collection("fp8"):
+            hist_l.value = jnp.concatenate([a_l[None], hist_l.value[:-1]])
+            hist_r.value = jnp.concatenate([a_r[None], hist_r.value[:-1]])
+        return fp8_dot_general(
+            lhs, rhs, s_l, s_r, dimension_numbers, dt
+        )
+
+
+def fp8_dot_general_cls(fp8: str | None):
+    """Resolve a model config's ``fp8`` field to a ``dot_general_cls``.
+
+    ``None``/"off" -> ``None`` (plain ``lax.dot_general``); "e4m3"/"e5m2"
+    -> a zero-arg :class:`Fp8DotGeneral` factory for
+    ``nn.Dense(dot_general_cls=...)``.
+    """
+    if fp8 in (None, "", "off", "none", "fp32"):
+        return None
+    if fp8 not in FP8_DTYPES:
+        raise ValueError(
+            f"unknown fp8 forward dtype {fp8!r}: expected one of "
+            f"{sorted(FP8_DTYPES)}"
+        )
+    return functools.partial(Fp8DotGeneral, fwd_dtype=fp8)

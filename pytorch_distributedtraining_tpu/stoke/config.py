@@ -96,6 +96,15 @@ class TPUConfig:
     # parallel/remat.py). Unset falls back to the GRAFT_REMAT env knob.
     remat: bool | str = False
     donate_state: bool = True
+    # Quantized gradient wire (parallel/compressed.py): a WireFormat
+    # spelling ("int8" | "int8_block" | "fp8_e4m3" | "fp8_e5m2", optional
+    # :BLOCK suffix) routes the fused step through CompressedGradStep;
+    # None/"" keeps TrainStep's f32 collectives. Env twin: $GRAFT_WIRE.
+    wire: str | None = None
+    # fp8 matmul compute ("e4m3" | "e5m2" — precision.fp8_dot_general_cls):
+    # cloned onto models whose cfg carries an ``fp8`` field (GPT-2/ViT).
+    # Env twin: $GRAFT_FP8.
+    fp8: str | None = None
 
 
 @dataclass

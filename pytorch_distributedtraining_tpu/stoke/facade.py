@@ -38,7 +38,13 @@ from .. import checkpoint as ckpt
 from .. import optim as optim_mod
 from ..data import DataLoader as _DataLoader
 from ..ops import sync_scalar_device
-from ..parallel import TrainStep, create_train_state, policy_from_flags
+from ..parallel import (
+    CompressedGradStep,
+    TrainStep,
+    create_train_state,
+    policy_from_flags,
+    wire_format,
+)
 from ..parallel.remat import apply_remat, resolve_remat
 from ..parallel.spec import constrain, shard_axis, stream_to_device
 from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
@@ -104,6 +110,54 @@ def _apply_scan_layers_env(model):
             return model
         return model.clone(cfg=dataclasses.replace(cfg, scan_layers=want))
     return model
+
+
+def _wire_from_env(cfg):
+    """Resolve the quantized gradient wire: ``$GRAFT_WIRE`` overrides
+    ``TPUConfig.wire`` (deploy-time twin, same pattern as GRAFT_REMAT).
+    Returns a ``WireFormat`` or None; a typoed spelling fails here, at
+    construction, not mid-training."""
+    spec = os.environ.get("GRAFT_WIRE", cfg.wire)
+    return wire_format(spec)
+
+
+def _apply_fp8_env(model, cfg):
+    """``$GRAFT_FP8``/``TPUConfig.fp8`` clone an fp8 matmul mode onto
+    models whose ``cfg`` dataclass carries an ``fp8`` field (GPT-2/ViT —
+    see ``precision.fp8_dot_general_cls``). Returns ``(model, mode)``;
+    models without the field pass through with a warning when the knob
+    is set — their matmuls have no fp8 tagging, and pretending otherwise
+    would mislabel every number downstream."""
+    spec = os.environ.get("GRAFT_FP8", cfg.fp8)
+    if spec is None or str(spec).strip().lower() in (
+        "", "off", "none", "0", "false",
+    ):
+        return model, None
+    from ..precision import FP8_DTYPES
+
+    mode = str(spec).strip().lower()
+    if mode not in FP8_DTYPES:
+        raise ValueError(
+            f"fp8 mode {spec!r} unknown; have {sorted(FP8_DTYPES)}"
+        )
+    mcfg = getattr(model, "cfg", None)
+    if (
+        hasattr(model, "clone")
+        and mcfg is not None
+        and hasattr(mcfg, "fp8")
+    ):
+        if mcfg.fp8 == mode:
+            return model, mode
+        return model.clone(cfg=dataclasses.replace(mcfg, fp8=mode)), mode
+    import warnings
+
+    warnings.warn(
+        f"fp8={mode!r} requested but {type(model).__name__} has no fp8 "
+        "config field — matmuls stay at the model dtype (the fp8 path "
+        "covers the GPT-2/ViT trunks)",
+        stacklevel=3,
+    )
+    return model, None
 
 
 @jax.jit
@@ -403,6 +457,12 @@ class Stoke:
         self.oss_config = self._find_config(FairscaleOSSConfig) or FairscaleOSSConfig()
         self.tpu_config = self._find_config(TPUConfig) or TPUConfig()
         ds_config = self._find_config(DeepspeedConfig)
+        # low-precision knobs (env > TPUConfig): quantized gradient wire
+        # and the fp8 matmul mode for models that implement it
+        self.wire = _wire_from_env(self.tpu_config)
+        self._module, self.fp8 = _apply_fp8_env(
+            self._module, self.tpu_config
+        )
 
         # -- distribution policy ------------------------------------------
         distributed = (
@@ -564,7 +624,19 @@ class Stoke:
                 "or ZeRO-1/OSS layout; ZeRO-2/3 shard grads/params per "
                 "leaf and keep the per-leaf chain"
             )
-        if fused_eligible and fused_optimizer is not False:
+        if fused_optimizer is True and self.wire is not None:
+            raise ValueError(
+                "fused_optimizer=True and a quantized gradient wire are "
+                "mutually exclusive: the wire quantizes per leaf, the "
+                "fused update ravels grads flat — drop one of the two"
+            )
+        # auto mode defers to a requested wire: CompressedGradStep is a
+        # per-leaf path, so the flat fused update cannot carry it
+        if (
+            fused_eligible
+            and fused_optimizer is not False
+            and self.wire is None
+        ):
             self._tx = optim_mod.FusedAdamW(lr=1.0, **kwargs)
         else:
             self._tx = factory(lr=1.0, **kwargs)
@@ -1201,6 +1273,43 @@ class Stoke:
             loss = loss_callable(out, y)
             aux = {"model_state": new_state} if new_state else {}
             return loss, aux
+
+        if self.wire is not None:
+            # quantized gradient wire: CompressedGradStep composes with
+            # DDP/ZeRO-1/ZeRO-2 on data-only meshes and owns its whole
+            # reduce path, so features TrainStep layers on top of psum
+            # (accum windows, the fp16 loss scaler, precision casts) fall
+            # back to the f32 wire rather than silently dropping
+            reason = None
+            if self.grad_accum_steps > 1:
+                reason = "grad_accum_steps > 1"
+            elif self.loss_scaler is not None:
+                reason = "the dynamic fp16 loss scaler"
+            elif self.fp16 is not None:
+                reason = f"the {self.fp16!r} precision policy"
+            elif self.pp > 1:
+                reason = "pipeline parallelism"
+            if reason is None:
+                try:
+                    self._fused = CompressedGradStep(
+                        loss_fn,
+                        self._tx,
+                        self.mesh,
+                        self.policy,
+                        donate=self.tpu_config.donate_state,
+                        wire=self.wire,
+                    )
+                    return self._fused
+                except ValueError as e:  # ZeRO-3 / non-data mesh axes
+                    reason = str(e)
+            import warnings
+
+            warnings.warn(
+                f"wire={self.wire.name!r} requested but the fused step "
+                f"does not compose with {reason}; falling back to "
+                "TrainStep's f32 gradient wire",
+                stacklevel=2,
+            )
 
         self._fused = TrainStep(
             loss_fn,

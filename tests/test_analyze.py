@@ -157,9 +157,14 @@ def test_seeded_fixture_produces_exactly_its_finding(name):
     step, state, batch, expected = build_fixture(name)
     rule_name, sev = expected
     report = analyze_step(step, state, batch)
-    assert [(f.rule, f.severity) for f in report.findings] == [
-        (rule_name, sev)
-    ], report.render()
+    got = [(f.rule, f.severity) for f in report.findings]
+    # advisory INFO riders are tolerated (e.g. the overlap audit noting
+    # XLA:CPU schedules no async collectives, which any fixture that
+    # compiles a real collective will trip); the warn+error set must be
+    # exactly the seeded expectation
+    assert [
+        (r, s) for r, s in got if s is not Severity.INFO
+    ] == [(rule_name, sev)], report.render()
 
 
 def test_clean_fixture_has_no_findings():

