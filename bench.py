@@ -1127,6 +1127,27 @@ def _bench() -> None:
             spec=dspec,
         )
 
+    # unified telemetry (observe/trace.py): ON by default in the bench
+    # child — the record's mfu/goodput_fraction/time_breakdown fields come
+    # from these spans. Explicit falsy GRAFT_TELEMETRY opts out (and the
+    # bench-telemetry graftcheck rule then WARNs the number is
+    # unattributable). Span cost is guarded below: >1% of the steady-state
+    # step refuses to publish (exit 9).
+    from pytorch_distributedtraining_tpu.observe import trace as telemetry
+
+    _tel_env = os.environ.get("GRAFT_TELEMETRY")
+    if _tel_env is None or _tel_env.strip().lower() not in (
+        "", "0", "false", "off", "no"
+    ):
+        telemetry.enable()
+
+    def _sync(x):
+        # the post-dispatch wait IS the device compute tail of a timed
+        # window — billed productive (cat "step") alongside the dispatch
+        # spans, so the ledger's wall-clock decomposition closes
+        with telemetry.span("device.sync", "step"):
+            jax.block_until_ready(x)
+
     print("# child: compiling + warmup", flush=True)
     trace_dir = os.environ.get("GRAFT_BENCH_TRACE")
     with mesh:
@@ -1154,6 +1175,9 @@ def _bench() -> None:
         # gates on the pair below)
         cache_entries_warm = cache_entry_count(cache_path)
         print("# child: warmup done, timing", flush=True)
+        # goodput-ledger bracket: every timed window (plus, on the scan
+        # arm, the scan compile) lands inside [t_meas0, t_meas1]
+        t_meas0 = time.perf_counter()
         # Best-of-N sustained windows: the shared pool's tunnel congestion
         # varies at the seconds scale (same committed config measured 12079
         # and 4851 img/s in two sessions, BASELINE.md r4). Each window is
@@ -1244,8 +1268,9 @@ def _bench() -> None:
             for w in range(windows):
                 t0 = time.perf_counter()
                 for _ in range(n_calls):
-                    state, losses = multi_step(state)
-                jax.block_until_ready(losses)
+                    with telemetry.span("step.dispatch", "step", k=k):
+                        state, losses = multi_step(state)
+                _sync(losses)
                 dt = time.perf_counter() - t0
                 rates.append(BATCH * k * n_calls / dt)
                 print(
@@ -1264,9 +1289,13 @@ def _bench() -> None:
                 t0 = time.perf_counter()
                 n_steps = 0
                 for b in it:
-                    state, metrics = step(state, b)
+                    # dispatch is billed productive: async backends return
+                    # in µs (the sync span carries the window), but when the
+                    # dispatch queue throttles, the wait is real step time
+                    with telemetry.span("step.dispatch", "step"):
+                        state, metrics = step(state, b)
                     n_steps += 1
-                jax.block_until_ready(metrics["loss"])
+                _sync(metrics["loss"])
                 dt = time.perf_counter() - t0
                 rates.append(BATCH * n_steps / dt)
                 overlap_fracs.append(it.overlap_fraction(dt))
@@ -1285,7 +1314,7 @@ def _bench() -> None:
                 t0 = time.perf_counter()
                 for _ in range(STEPS):
                     state, metrics = step(state, batch)
-                jax.block_until_ready(metrics["loss"])
+                _sync(metrics["loss"])
                 dt = time.perf_counter() - t0
                 rates.append(BATCH * STEPS / dt)
                 print(
@@ -1294,6 +1323,7 @@ def _bench() -> None:
                     flush=True,
                 )
 
+    t_meas1 = time.perf_counter()
     # untimed verification fetch: the loss chains through every timed
     # step, so a real finite host value proves the windows executed —
     # block_until_ready through the experimental tunnel under-blocked in
@@ -1336,6 +1366,113 @@ def _bench() -> None:
         best = rates.index(img_per_sec)
         f = overlap_fracs[best]
         overlap_fraction = None if f is None else round(f, 4)
+    # Goodput/MFU ledger (untimed): classify the measurement interval's
+    # wall clock from the spans recorded during the windows, and report
+    # utilization against the analytic per-image train FLOPs — the
+    # decomposition BASELINE.md's variance post-mortems needed (is a slow
+    # window compile, input-wait, or tunnel weather?).
+    mfu_val = None
+    goodput_fraction = None
+    time_breakdown = None
+    telemetry_overhead_fraction = None
+    if telemetry.enabled():
+        from pytorch_distributedtraining_tpu.observe.goodput import (
+            GoodputLedger,
+            mfu as _mfu,
+            model_train_flops,
+        )
+
+        ledger = GoodputLedger.from_records(
+            telemetry.records(), t_meas0, t_meas1
+        )
+        gf = ledger.goodput_fraction()
+        goodput_fraction = None if gf is None else round(gf, 4)
+        time_breakdown = ledger.time_breakdown()
+        step_time_best = BATCH / img_per_sec  # best window, per step
+        dev0 = jax.devices()[0]
+        try:
+            flops_per_step = model_train_flops(model, BATCH, (PATCH, PATCH))
+            m = _mfu(
+                flops_per_step,
+                step_time_best,
+                n_devices=1,  # the timed mesh is a single device
+                platform=dev0.platform,
+                device_kind=getattr(dev0, "device_kind", ""),
+            )
+            mfu_val = None if m is None else round(m, 6)
+        except Exception as e:  # noqa: BLE001 — accounting, not the metric
+            print(f"# child: mfu unavailable: {e}", flush=True)
+        # overhead guard: measure raw span cost AFTER the windows (the
+        # probe spans fall outside the ledger bracket) and scale by the
+        # spans-per-step the windows actually recorded
+        n_window_spans = sum(
+            1 for r in telemetry.records()
+            if not r.get("instant") and t_meas0 <= r["t0"] <= t_meas1
+        )
+        probe_n = 2000
+        t_p = time.perf_counter()
+        for _ in range(probe_n):
+            with telemetry.span("overhead.probe", "other"):
+                pass
+        per_span_s = (time.perf_counter() - t_p) / probe_n
+        spans_per_step = n_window_spans / max(1, len(rates) * actual_steps)
+        telemetry_overhead_fraction = round(
+            per_span_s * spans_per_step / max(step_time_best, 1e-9), 6
+        )
+        print(
+            "# child: telemetry "
+            + json.dumps({
+                "mfu": mfu_val,
+                "goodput_fraction": goodput_fraction,
+                "time_breakdown": time_breakdown,
+                "overhead_fraction": telemetry_overhead_fraction,
+                "spans_per_step": round(spans_per_step, 3),
+            }),
+            flush=True,
+        )
+        # same counters through the sink layer (rank-0 JSONL under the
+        # run dir), so harvest tooling reads them without parsing stdout
+        try:
+            from pytorch_distributedtraining_tpu.observe.sink import (
+                JSONLSink,
+            )
+
+            _sink = JSONLSink()
+            _sink.log({
+                "bench_img_per_sec": round(img_per_sec, 2),
+                "mfu": mfu_val,
+                "goodput_fraction": goodput_fraction,
+                **{
+                    f"time_{k}_s": v
+                    for k, v in (time_breakdown or {}).items()
+                },
+            })
+            _sink.finish()
+        except Exception as e:  # noqa: BLE001 — logging must not kill a run
+            print(f"# child: telemetry sink unavailable: {e}", flush=True)
+        if (os.environ.get("GRAFT_TRACE") or "").strip():
+            try:
+                print(
+                    "# child: telemetry trace written: "
+                    + telemetry.export_chrome_trace(),
+                    flush=True,
+                )
+            except Exception as e:  # noqa: BLE001
+                print(f"# child: trace export failed: {e}", flush=True)
+        if telemetry_overhead_fraction > 0.01:
+            # no "# " prefix: _informative_tail must pick THIS line as
+            # the cause in the parent's error record
+            print(
+                f"TELEMETRY OVERHEAD: span cost "
+                f"{telemetry_overhead_fraction:.2%} of the steady-state "
+                f"step ({per_span_s * 1e6:.1f} us/span x "
+                f"{spans_per_step:.2f} spans/step vs "
+                f"{step_time_best * 1e3:.3f} ms/step) exceeds the 1% "
+                "budget — the instrument is distorting the measurement, "
+                "refusing to publish",
+                flush=True,
+            )
+            sys.exit(9)
     # graftcheck (untimed; must run BEFORE the accounting passes below —
     # memory_analysis/pipeline probe legitimately add cache entries, so
     # the recompile-drift window closes here): trace+HLO rules over the
@@ -1539,6 +1676,10 @@ def _bench() -> None:
                     prefetch_depth if feed_impl == "prefetch" else None
                 ),
                 "overlap_fraction": overlap_fraction,
+                "mfu": mfu_val,
+                "goodput_fraction": goodput_fraction,
+                "time_breakdown": time_breakdown,
+                "telemetry_overhead_fraction": telemetry_overhead_fraction,
                 "compile_cache": compile_cache,
                 "static_findings": static_findings,
                 "peak_hbm_bytes": peak_hbm_bytes,

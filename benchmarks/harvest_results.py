@@ -25,6 +25,8 @@ STAGES = [
     ("bench", "headline SwinIR-S x2 train step (bench.py, committed knobs)"),
     ("analyze", "graftcheck static analysis of the flagship step "
                 "(python -m pytorch_distributedtraining_tpu.analyze)"),
+    ("telemetry", "goodput/MFU breakdown (bench.py telemetry ledger + "
+                  "trace_summary.py span rollup)"),
     ("compile", "cold vs cached vs scanned compile time (compile_bench.py)"),
     ("bench_remat", "bench.py, GRAFT_REMAT=full (activation remat arm)"),
     ("bench_scan_layers", "bench.py, GRAFT_SCAN_LAYERS=1 (scanned RSTBs)"),

@@ -1,16 +1,27 @@
-"""Summarize a jax.profiler chrome trace: top ops by device time.
+"""Summarize Chrome trace-event JSON: profiler ops AND telemetry spans.
 
-Give it the directory passed as ``GRAFT_BENCH_TRACE`` (bench.py writes a
-3-step steady-state trace there) and it aggregates `X` duration events per
-lane, preferring device lanes (TPU pids) over host lanes, so the MFU
-question — *which ops own the step time?* — is answerable without
-TensorBoard. Framework-internal python frames (``$file.py:line`` names)
-and the block_until_ready scaffolding are excluded.
+One tool for both trace producers in this repo — they share the
+trace-event format, so they share the summarizer:
+
+- jax.profiler xplane dumps (the directory passed as
+  ``GRAFT_BENCH_TRACE``; bench.py writes a 3-step steady-state trace
+  there): aggregates `X` duration events per lane, preferring device
+  lanes (TPU pids) over host lanes, so the MFU question — *which ops own
+  the step time?* — is answerable without TensorBoard.
+- observe/trace.py telemetry exports (``telemetry-<pid>.trace.json``,
+  written by ``--trace`` / ``Stoke.export_trace`` / bench telemetry;
+  their process_name lane starts with ``graft-telemetry``): rolls spans
+  up by category — the stdout twin of the goodput ledger's
+  time_breakdown — plus instant-event counts (fault injections,
+  recompiles).
 
     python benchmarks/trace_summary.py /tmp/tpu_results/xplane --top 25
+    python benchmarks/trace_summary.py /tmp/graft-runs/<pid> --top 25
 
-One JSON line per op row plus a total line; also prints the share of the
-summed lane time each op owns.
+One JSON line per row plus a total line; also prints the share of the
+summed lane time each row owns. Framework-internal python frames
+(``$file.py:line`` names) and the block_until_ready scaffolding are
+excluded from op summaries.
 """
 
 from __future__ import annotations
@@ -121,21 +132,84 @@ def summarize(events, top: int):
     return lanes, rows, total
 
 
+def telemetry_rollup(events, top: int):
+    """Category + span rollup for graft-telemetry lanes.
+
+    The per-category row is the stdout twin of the goodput ledger's
+    ``time_breakdown`` (same cats, pre-bucketing); instants (fault
+    injections, recompile markers) are counted by name — zero-duration
+    events would vanish from a duration summary.
+    """
+    by_cat = collections.Counter()
+    by_span = collections.Counter()
+    instants = collections.Counter()
+    for e in events:
+        if e.get("ph") == "i":
+            instants[e.get("name", "?")] += 1
+        elif e.get("ph") == "X":
+            by_cat[e.get("cat", "other")] += e.get("dur", 0.0)
+            by_span[e.get("name", "?")] += e.get("dur", 0.0)
+    total = sum(by_cat.values())
+    rows = [
+        {
+            "cat": cat,
+            "ms": round(v / 1e3, 3),
+            "share": round(v / total, 4) if total else 0.0,
+        }
+        for cat, v in by_cat.most_common()
+    ]
+    rows += [
+        {
+            "span": name,
+            "ms": round(v / 1e3, 3),
+            "share": round(v / total, 4) if total else 0.0,
+        }
+        for name, v in by_span.most_common(top)
+    ]
+    rows += [
+        {"instant": name, "count": n} for name, n in instants.most_common()
+    ]
+    return rows, total
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("trace_dir")
     ap.add_argument("--top", type=int, default=25)
     opt = ap.parse_args(argv)
     events, n_files = load_events(opt.trace_dir)
-    lanes, rows, total = summarize(events, opt.top)
-    print(json.dumps({
-        "lanes": sorted(set(lanes.values())),
-        "total_op_ms": round(total / 1e3, 3),
-        "n_events": len(events),
-        "n_trace_files": n_files,
-    }))
-    for r in rows:
-        print(json.dumps(r))
+    lanes = {
+        e["pid"]: e.get("args", {}).get("name", "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    tel_pids = {
+        pid for pid, name in lanes.items()
+        if (name or "").startswith("graft-telemetry")
+    }
+    tel_events = [e for e in events if e.get("pid") in tel_pids]
+    op_events = [e for e in events if e.get("pid") not in tel_pids]
+    if tel_events:
+        rows, total = telemetry_rollup(tel_events, opt.top)
+        print(json.dumps({
+            "telemetry_lanes": sorted(
+                lanes[p] for p in tel_pids
+            ),
+            "total_span_ms": round(total / 1e3, 3),
+            "n_events": len(tel_events),
+        }))
+        for r in rows:
+            print(json.dumps(r))
+    if not tel_events or any(e.get("ph") == "X" for e in op_events):
+        lanes_op, rows, total = summarize(op_events, opt.top)
+        print(json.dumps({
+            "lanes": sorted(set(lanes_op.values())),
+            "total_op_ms": round(total / 1e3, 3),
+            "n_events": len(op_events),
+            "n_trace_files": n_files,
+        }))
+        for r in rows:
+            print(json.dumps(r))
 
 
 if __name__ == "__main__":

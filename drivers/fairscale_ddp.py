@@ -61,6 +61,11 @@ TARGET_PATH = "/opt/hubshare/vectorly-share/shared/Image_Superresolution/Dataset
 def train(rank: int, world_size: int, epochs: int, opt=None):
     # process-group init twin (Fairscale-DDP.py:27): env:// rendezvous
     runtime.initialize()
+    # unified telemetry: --trace/$GRAFT_TRACE/$GRAFT_TELEMETRY turn the
+    # tracer on here (this driver builds steps directly, no Stoke facade)
+    from pytorch_distributedtraining_tpu.observe import trace as telemetry
+
+    telemetry.configure_from_env()
     pp = max(1, int(getattr(opt, "pp", 1)))
     if pp > 1:
         # --pp shapes the mesh with a pipeline axis (remaining devices on
@@ -198,6 +203,11 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
                 print(loss)
         print("For Epoch {}, loss: {:.2f}".format(e, float(loss)))
 
+    if telemetry.enabled():
+        trace_path = telemetry.export_chrome_trace()
+        print(f"===> telemetry trace written: {trace_path} "
+              "(load in Perfetto / chrome://tracing)")
+
     runtime.shutdown()
     return float(loss) if loss is not None else None
 
@@ -245,7 +255,20 @@ def main(argv=None):
                              "error additionally aborts on error-severity "
                              "findings (bare --analyze = error; env twin "
                              "$GRAFT_ANALYZE)")
+    parser.add_argument("--trace", type=str, nargs="?", const="",
+                        default=os.environ.get("GRAFT_TRACE"),
+                        help="enable unified telemetry (step spans, goodput "
+                             "ledger, crash flight recorder) and export a "
+                             "Chrome trace-event JSON at exit — bare "
+                             "--trace writes under the run dir, --trace DIR "
+                             "writes there (env twin $GRAFT_TRACE; "
+                             "$GRAFT_TELEMETRY=0 force-disables)")
     opt = parser.parse_args(argv)
+
+    if opt.trace is not None:
+        os.environ.setdefault("GRAFT_TELEMETRY", "1")
+        if opt.trace:
+            os.environ["GRAFT_TRACE"] = opt.trace
 
     # GRAFT_PLATFORM=cpu forces the backend (see runtime.dist docstring:
     # some images re-latch JAX_PLATFORMS before user code runs)

@@ -243,6 +243,14 @@ def build_parser():
                              "report, error additionally aborts on "
                              "error-severity findings (bare --analyze = "
                              "error; env twin $GRAFT_ANALYZE)")
+    parser.add_argument("--trace", type=str, nargs="?", const="",
+                        default=os.environ.get("GRAFT_TRACE"),
+                        help="enable unified telemetry (step spans, goodput "
+                             "ledger, crash flight recorder) and export a "
+                             "Chrome trace-event JSON at exit — bare "
+                             "--trace writes under the run dir, --trace DIR "
+                             "writes there (env twin $GRAFT_TRACE; "
+                             "$GRAFT_TELEMETRY=0 force-disables)")
     return parser
 
 
@@ -314,6 +322,14 @@ def main(argv=None):
     if opt.fp8:
         os.environ["GRAFT_FP8"] = opt.fp8
         print(f"===> fp8 matmul mode={opt.fp8}")
+
+    # --trace threads telemetry through its env twins: the facade enables
+    # the tracer at construction; export happens after the epoch loop
+    if opt.trace is not None:
+        os.environ.setdefault("GRAFT_TELEMETRY", "1")
+        if opt.trace:
+            os.environ["GRAFT_TRACE"] = opt.trace
+        print(f"===> telemetry on (trace dir: {opt.trace or 'run dir'})")
 
     optimizer = StokeOptimizer(
         optimizer="AdamW",
@@ -434,6 +450,10 @@ def main(argv=None):
         print("--------Val Loss after Epoch {} - {} --------".format(epoch, val_loss))
 
     wandb.finish()
+    trace_path = stoke_model.export_trace()
+    if trace_path:
+        print(f"===> telemetry trace written: {trace_path} "
+              "(load in Perfetto / chrome://tracing)")
     train_dataloader.shutdown_workers()
     val_dataloader.shutdown_workers()
     return train_loss, val_loss

@@ -1,0 +1,50 @@
+"""Runtime-plane rules over measured process state (not jaxpr/HLO).
+
+The runtime plane reads facts only the live process knows: env knobs,
+loaded modules, harness state. First resident: the bench-telemetry rule —
+a benchmark run whose step is being timed without the unified telemetry
+layer (observe/trace.py) publishes a throughput number with no goodput/
+MFU decomposition behind it, which BASELINE.md's variance post-mortems
+showed is exactly when tunnel-weather artifacts get mistaken for
+regressions.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from .findings import Finding, Severity
+from .registry import rule
+
+
+@rule(
+    "bench-telemetry",
+    "runtime",
+    "bench step timed without the unified telemetry layer enabled",
+)
+def bench_telemetry(ctx):
+    if not (
+        os.environ.get("_GRAFT_BENCH_CHILD")
+        or os.environ.get("GRAFT_BENCH")
+    ):
+        return
+    # sys.modules lookup, not an import: this module must stay importable
+    # from jax-free tooling, and an un-imported tracer IS the finding
+    tr = sys.modules.get("pytorch_distributedtraining_tpu.observe.trace")
+    if tr is not None and tr.enabled():
+        return
+    yield Finding(
+        "bench-telemetry",
+        Severity.WARN,
+        "runtime:telemetry",
+        "bench run is timing the step without telemetry: the published "
+        "record will carry no goodput/MFU breakdown, so a slow window "
+        "cannot be attributed (compile vs input-wait vs outage). Unset "
+        "GRAFT_TELEMETRY=0 (bench enables the tracer by default) or "
+        "accept an unattributable number",
+        evidence=(
+            "observe.trace "
+            + ("loaded but disabled" if tr is not None else "never imported")
+        ),
+    )

@@ -29,6 +29,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from .observe import trace as telemetry
 from .resilience.faults import fault_point
 from .resilience.outage import OutageClass, RetryPolicy, classify_exception
 
@@ -64,8 +65,9 @@ def save_sharded(
         # chaos site: the I/O error surfaces where a real one would — at
         # the actual write, after the checkpointer is constructed
         fault_point("checkpoint.write", path=path)
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(path, state, force=force)
+        with telemetry.span("checkpoint.write", "checkpoint", path=path):
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save(path, state, force=force)
 
     policy.run(
         _write,
@@ -171,7 +173,13 @@ class CheckpointManager:
             # same chaos site as the sync path; async initiation errors
             # surface here, commit errors at wait_until_finished
             fault_point("checkpoint.write", path=path)
-            self._async_ckptr.save(path, state, force=True)
+            # the span covers only save *initiation*: the async write's
+            # body overlaps training by design and must not be billed as
+            # checkpoint wall time (wait() below carries the blocking tail)
+            with telemetry.span(
+                "checkpoint.write.async", "checkpoint", path=path
+            ):
+                self._async_ckptr.save(path, state, force=True)
             return path
         path = save_sharded(self._step_dir(step), state, force=True)
         self._gc()
@@ -180,7 +188,8 @@ class CheckpointManager:
     def wait(self) -> None:
         """Block until any in-flight async save has fully landed on disk."""
         if self._async_ckptr is not None:
-            self._async_ckptr.wait_until_finished()
+            with telemetry.span("checkpoint.wait", "checkpoint"):
+                self._async_ckptr.wait_until_finished()
             self._gc()  # the save that just landed now counts toward keep
 
     def _preempted_anywhere(self) -> bool:
@@ -196,7 +205,8 @@ class CheckpointManager:
         import jax.numpy as jnp
         from jax.experimental import multihost_utils
 
-        flags = multihost_utils.process_allgather(jnp.array([local]))
+        with telemetry.span("preempt.agreement", "collective"):
+            flags = multihost_utils.process_allgather(jnp.array([local]))
         return bool(np.asarray(flags).any())
 
     def maybe_save(self, step: int, state: Any) -> str | None:

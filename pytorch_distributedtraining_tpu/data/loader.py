@@ -20,10 +20,12 @@ from __future__ import annotations
 import multiprocessing
 import queue
 import threading
+import time
 from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 
 import numpy as np
 
+from ..observe import trace as telemetry
 from ..resilience.faults import fault_point
 from .sampler import DistributedSampler
 
@@ -422,7 +424,14 @@ class DataLoader:
         # which stages them asynchronously instead
         if self.num_workers <= 0:
             for idxs in batches:
+                t0 = time.perf_counter()
                 item = self.collate_fn([self.dataset[i] for i in idxs])
+                if telemetry.enabled():
+                    # synchronous fetch+collate = unoverlapped input time
+                    telemetry.add_span(
+                        "input.fetch", "input", t0,
+                        time.perf_counter() - t0,
+                    )
                 yield self._to_device(item) if to_device else item
             return
 
@@ -479,7 +488,14 @@ class DataLoader:
         t.start()
         try:
             while True:
+                t0 = time.perf_counter()
                 item = q.get()
+                if telemetry.enabled():
+                    # consumer blocked on the feeder = input_wait bucket
+                    telemetry.add_span(
+                        "input.wait", "input", t0,
+                        time.perf_counter() - t0,
+                    )
                 if item is _END:
                     return
                 if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:

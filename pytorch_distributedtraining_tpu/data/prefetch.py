@@ -208,6 +208,15 @@ class DevicePrefetcher:
         self.wait_s += dt
         if self.probe is not None:
             self.probe.note_wait(dt)
+        from ..observe import trace as telemetry
+
+        if telemetry.enabled():
+            # the wait IS the unhidden input time (goodput input_wait
+            # bucket) — recorded consumer-side so it never double-bills
+            # the feeder thread's overlapped staging
+            telemetry.add_span(
+                "input.wait", "input", t0, dt, {"n": self.yielded}
+            )
         self.yielded += 1
         return payload
 

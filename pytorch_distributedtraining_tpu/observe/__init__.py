@@ -31,8 +31,29 @@ from .memory import (
     device_hbm_budget,
     tune_batch_size,
 )
+from . import trace  # the span-telemetry module (observe.trace)
+from .goodput import (
+    GoodputLedger,
+    StepLog,
+    StragglerReport,
+    flag_stragglers,
+    mfu,
+    model_train_flops,
+    peak_flops,
+    read_step_logs,
+    straggler_check,
+)
 from .sink import JSONLSink, MetricsSink, NullSink, WandbSink, make_sink
-from .profiling import StepTimer, TransferOverlapProbe, trace
+from .profiling import StepTimer, TransferOverlapProbe
+from .profiling import trace as profiler_trace
+from .trace import (
+    Tracer,
+    export_chrome_trace,
+    flush_flight_record,
+    instant,
+    span,
+    traced,
+)
 
 __all__ = [
     "wandb",
@@ -44,6 +65,22 @@ __all__ = [
     "StepTimer",
     "TransferOverlapProbe",
     "trace",
+    "profiler_trace",
+    "Tracer",
+    "span",
+    "traced",
+    "instant",
+    "export_chrome_trace",
+    "flush_flight_record",
+    "GoodputLedger",
+    "StepLog",
+    "StragglerReport",
+    "flag_stragglers",
+    "straggler_check",
+    "read_step_logs",
+    "mfu",
+    "model_train_flops",
+    "peak_flops",
     "CollectiveOp",
     "HloInstruction",
     "tokenize_hlo",

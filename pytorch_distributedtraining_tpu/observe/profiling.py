@@ -30,11 +30,18 @@ def trace(logdir: str = "/tmp/jax-trace"):
 
 @dataclass
 class StepTimer:
-    """Track step wall-times; ``summary()`` gives p50/p90/mean excluding
-    warmup (compile) steps."""
+    """Track step wall-times; ``summary()`` gives p50/p90/p99/mean/tails
+    excluding warmup (compile) steps.
+
+    When telemetry is enabled (``observe.trace``), every timed step is
+    also folded into the span ring buffer as a ``train.step`` span —
+    the timer and the goodput ledger read the same measurements, so the
+    two timing paths cannot disagree.
+    """
 
     warmup: int = 2
     times: list = field(default_factory=list)
+    span_name: str = "train.step"
     _t0: float | None = None
 
     def __enter__(self):
@@ -42,7 +49,18 @@ class StepTimer:
         return self
 
     def __exit__(self, *exc):
-        self.times.append(time.perf_counter() - self._t0)
+        dt = time.perf_counter() - self._t0
+        self.times.append(dt)
+        from . import trace as _trace
+
+        if _trace.enabled():
+            # warmup steps are compile-bucket by construction
+            n = len(self.times)
+            _trace.add_span(
+                self.span_name,
+                "compile" if n <= self.warmup else "step",
+                self._t0, dt, {"n": n},
+            )
         self._t0 = None
 
     def summary(self) -> dict:
@@ -56,7 +74,9 @@ class StepTimer:
             "mean_s": sum(s) / n,
             "p50_s": s[n // 2],
             "p90_s": s[min(n - 1, int(0.9 * n))],
+            "p99_s": s[min(n - 1, int(0.99 * n))],
             "min_s": s[0],
+            "max_s": s[-1],
         }
 
     def throughput(self, items_per_step: int) -> float:

@@ -11,16 +11,45 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 from typing import Any
 
 from ..resilience.outage import RetryPolicy
+from .trace import run_dir
 
 
 def _is_rank0() -> bool:
-    import jax
+    """Process-0 gate that never *initializes* a backend.
 
-    return jax.process_index() == 0
+    ``jax.process_index()`` on a fresh interpreter spins up the platform
+    (and used to make the first ``sink.log`` call the accidental backend
+    init). Resolution order: rank env vars (set by the launcher and every
+    multi-process runtime), then jax — but only if jax is already
+    imported, and guarded so a backend failure degrades to rank-0
+    behavior rather than killing the log call.
+    """
+    for var in ("GRAFT_RANK", "JAX_PROCESS_ID", "RANK"):
+        raw = os.environ.get(var)
+        if raw is not None:
+            try:
+                return int(raw) == 0
+            except ValueError:
+                pass
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return True
+    try:
+        return jax.process_index() == 0
+    except Exception:  # noqa: BLE001 — logging must not require a backend
+        return True
+
+
+def _default_path() -> str:
+    """Default JSONL location: under the run dir, never the cwd (a
+    committed ``metrics.jsonl`` in the repo root was this default's
+    legacy noise)."""
+    return os.path.join(run_dir(), "metrics.jsonl")
 
 
 class MetricsSink:
@@ -41,8 +70,8 @@ class NullSink(MetricsSink):
 class JSONLSink(MetricsSink):
     """Offline fallback: one JSON object per log call."""
 
-    def __init__(self, path: str = "metrics.jsonl"):
-        self.path = path
+    def __init__(self, path: str | None = None):
+        self.path = path or _default_path()
         self._f = None
 
     def log(self, metrics, step=None):
@@ -118,13 +147,13 @@ class WandbSink(MetricsSink):
 def make_sink(project: str | None = None, config: dict | None = None, **kwargs) -> MetricsSink:
     """Best sink available: wandb if importable+enabled, else JSONL."""
     if os.environ.get("WANDB_MODE") == "disabled" or project is None:
-        return JSONLSink(kwargs.get("path", "metrics.jsonl"))
+        return JSONLSink(kwargs.get("path"))
     try:
         import wandb  # noqa: F401
 
         return WandbSink(project, config, **kwargs)
     except Exception:
-        return JSONLSink(kwargs.get("path", "metrics.jsonl"))
+        return JSONLSink(kwargs.get("path"))
 
 
 def _scalar(v):

@@ -586,5 +586,10 @@ class CompressedGradStep:
         return compiled_memory_stats(compiled)
 
     def __call__(self, state: TrainState, batch, lr_factor: float = 1.0):
+        from ..observe import trace as telemetry
+
         state = self._with_residuals(state)
-        return self._jitted(state, batch, jnp.float32(lr_factor))
+        with telemetry.dispatch_span(self, "CompressedGradStep"):
+            out = self._jitted(state, batch, jnp.float32(lr_factor))
+        telemetry.note_recompile(self, self._jitted, "CompressedGradStep")
+        return out

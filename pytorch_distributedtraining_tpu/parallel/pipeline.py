@@ -1015,4 +1015,9 @@ class PipelineStep:
         return compiled_memory_stats(compiled)
 
     def __call__(self, state, batch, lr_factor: float = 1.0):
-        return self._jitted(state, batch, jnp.float32(lr_factor))
+        from ..observe import trace as telemetry
+
+        with telemetry.dispatch_span(self, "PipelineStep"):
+            out = self._jitted(state, batch, jnp.float32(lr_factor))
+        telemetry.note_recompile(self, self._jitted, "PipelineStep")
+        return out

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..observe import trace as telemetry
 from ..optim import FusedAdamW, refresh_params_ema
 from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
 from ..runtime.mesh import batch_spec, stacked_batch_spec
@@ -434,7 +435,13 @@ class TrainStep:
         return compiled_memory_stats(compiled)
 
     def __call__(self, state: TrainState, batch, lr_factor: float = 1.0):
-        return self._jitted(state, batch, jnp.float32(lr_factor))
+        # async dispatch: the span covers trace/compile + enqueue, not
+        # device execution (which overlaps the host's next iteration —
+        # the final block_until_ready's sync span absorbs the remainder)
+        with telemetry.dispatch_span(self, "TrainStep"):
+            out = self._jitted(state, batch, jnp.float32(lr_factor))
+        telemetry.note_recompile(self, self._jitted, "TrainStep")
+        return out
 
 
 class MultiStep:
@@ -497,7 +504,7 @@ class MultiStep:
                 f"stacked batch has window {k}, MultiStep compiled for "
                 f"{self.k}"
             )
-        with self.step.mesh:
+        with self.step.mesh, telemetry.dispatch_span(self, "MultiStep"):
             return self._jitted(state, batches, jnp.float32(lr_factor))
 
     def feed(self, loader, depth: int | None = None):
@@ -634,5 +641,5 @@ class EvalStep:
         self._jitted = jax.jit(run, in_shardings=in_shardings)
 
     def __call__(self, state: TrainState, batch):
-        with self.mesh:
+        with self.mesh, telemetry.dispatch_span(self, "EvalStep"):
             return self._jitted(state.params, batch, state.model_state)

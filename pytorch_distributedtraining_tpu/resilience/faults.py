@@ -64,6 +64,7 @@ from __future__ import annotations
 import json
 import os
 import signal
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -87,6 +88,27 @@ SITES = frozenset({
 
 class InjectedFault(RuntimeError):
     """An error raised on purpose by a FaultPlan rule."""
+
+
+def _telemetry_on_fire(site: str, action: str, msg: str) -> None:
+    """Mark the injection in the telemetry stream, if telemetry is loaded.
+
+    Looked up via ``sys.modules`` — never imported — so this module keeps
+    its stdlib-only contract (the jax-free bench parent and launcher both
+    import it). When the tracer is live, the injection lands as an instant
+    event and the flight recorder is flushed BEFORE the action executes:
+    for ``kill``/``exit`` actions this flush is the only record the process
+    leaves behind.
+    """
+    tr = sys.modules.get("pytorch_distributedtraining_tpu.observe.trace")
+    if tr is None:
+        return
+    try:
+        if tr.enabled():
+            tr.instant(f"fault.{site}", "fault", action=action, message=msg)
+            tr.flush_flight_record(f"fault:{site}")
+    except Exception:
+        pass  # injection semantics must never depend on telemetry health
 
 
 @dataclass
@@ -153,6 +175,7 @@ class FaultRule:
 
     def fire(self, site_msg: str) -> None:
         msg = self.message or f"injected fault at {site_msg}"
+        _telemetry_on_fire(site_msg, self.action, msg)
         if self.action == "raise":
             raise InjectedFault(msg)
         if self.action == "oserror":

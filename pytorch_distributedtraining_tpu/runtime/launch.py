@@ -44,6 +44,11 @@ def _child_env(
         MASTER_ADDR=master_addr,
         MASTER_PORT=str(master_port),
     )
+    # one shared run dir across all ranks (keyed on the LAUNCHER's pid, so
+    # every generation's children agree): telemetry flight records and
+    # per-rank step logs land where rank-0 aggregation and the restart
+    # gate below can find them (observe/trace.py run_dir contract)
+    env.setdefault("GRAFT_RUN_DIR", f"/tmp/graft-runs/launch-{os.getpid()}")
     if one_cpu_device:
         # localhost testing: each rank gets its own single-device CPU
         # backend (the gloo-on-localhost analogue, Fairscale-DDP.py:27).
@@ -206,6 +211,49 @@ def _run_world(opt, attempt: int) -> int:
     return code
 
 
+def _report_flight_records(run_dir: str) -> None:
+    """Print (and consume) telemetry flight records left by dead children.
+
+    Inline json/os only — importing ``observe`` would pull jax into the
+    launcher, which must stay stdlib-importable. Each record answers the
+    question a restart gate actually has: what was the dying rank DOING?
+    Consumed files are removed so the next generation reports fresh.
+    """
+    import json as _json
+
+    try:
+        names = sorted(
+            n for n in os.listdir(run_dir) if n.startswith("flightrec-")
+        )
+    except OSError:
+        return
+    for name in names:
+        path = os.path.join(run_dir, name)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = _json.load(fh)
+        except (OSError, ValueError):
+            continue
+        inflight = doc.get("in_flight") or []
+        doing = (
+            f"was in span {inflight[-1].get('name')!r}"
+            f" ({inflight[-1].get('cat')})"
+            if inflight else "had no span in flight"
+        )
+        exc = doc.get("exception") or {}
+        tail = f" [{exc['type']}: {exc.get('message', '')}]" if exc else ""
+        print(
+            f"[launch] flight record: rank {doc.get('rank')} "
+            f"pid {doc.get('pid')} ({doc.get('reason')}) {doing}{tail}",
+            file=sys.stderr,
+            flush=True,
+        )
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="TPU-native torch.distributed.launch twin"
@@ -257,10 +305,16 @@ def main(argv=None) -> int:
         max_delay_s=30.0,
     )
     delays = policy.delays()
+    # mirrors _child_env's setdefault: the same expression in the same
+    # process, so the gate reads exactly where the children wrote
+    run_dir = os.environ.get(
+        "GRAFT_RUN_DIR", f"/tmp/graft-runs/launch-{os.getpid()}"
+    )
     for attempt in range(opt.max_restarts + 1):
         code = _run_world(opt, attempt)
         if code == 0:
             return 0
+        _report_flight_records(run_dir)
         cls = classify(code)
         if attempt < opt.max_restarts:
             if cls is OutageClass.DETERMINISTIC:

@@ -105,6 +105,11 @@ class TPUConfig:
     # cloned onto models whose cfg carries an ``fp8`` field (GPT-2/ViT).
     # Env twin: $GRAFT_FP8.
     fp8: str | None = None
+    # Unified telemetry (observe/trace.py): step spans, goodput ledger,
+    # flight recorder. Env twins: $GRAFT_TELEMETRY enables/disables;
+    # $GRAFT_TRACE also enables and names the Chrome-trace export path.
+    telemetry: bool = False
+    trace_dir: str | None = None
 
 
 @dataclass

@@ -160,6 +160,21 @@ def _apply_fp8_env(model, cfg):
     return model, None
 
 
+def _telemetry_from_env(cfg):
+    """Resolve the telemetry switch: ``$GRAFT_TELEMETRY`` overrides
+    ``TPUConfig.telemetry`` (deploy-time twin, same pattern as GRAFT_WIRE);
+    a non-empty ``$GRAFT_TRACE`` — the Chrome-trace export destination —
+    also turns the tracer on, overriding ``TPUConfig.trace_dir``. Returns
+    ``(enabled, trace_dir)``; an explicit falsy $GRAFT_TELEMETRY wins over
+    everything, so an operator can silence an instrumented config."""
+    trace_dir = os.environ.get("GRAFT_TRACE", cfg.trace_dir) or None
+    env = os.environ.get("GRAFT_TELEMETRY")
+    if env is not None:
+        on = env.strip().lower() not in ("", "0", "false", "off", "no")
+        return on, trace_dir
+    return bool(cfg.telemetry or trace_dir), trace_dir
+
+
 @jax.jit
 def _ema_update(ema, val):
     """0.98-decay loss monitor folded on device (`Stoke-DDP.py:76` EMA);
@@ -463,6 +478,13 @@ class Stoke:
         self._module, self.fp8 = _apply_fp8_env(
             self._module, self.tpu_config
         )
+        # unified telemetry (env > TPUConfig): step spans + goodput ledger
+        # + crash flight recorder; export_trace() writes the Chrome trace
+        self.telemetry, self.trace_dir = _telemetry_from_env(self.tpu_config)
+        if self.telemetry:
+            from ..observe import trace as _telemetry
+
+            _telemetry.enable()
 
         # -- distribution policy ------------------------------------------
         distributed = (
@@ -1608,6 +1630,27 @@ class Stoke:
             self.print_on_devices(
                 f"restored sharded checkpoint @ step {int(self._state.step)}"
             )
+
+    def export_trace(self, path: str | None = None) -> str | None:
+        """Write recorded telemetry spans as Chrome trace-event JSON.
+
+        Destination precedence: explicit ``path`` > ``trace_dir`` resolved
+        at construction ($GRAFT_TRACE / TPUConfig.trace_dir) > the shared
+        run dir. Returns the written path, or None when telemetry was
+        never enabled (nothing to export ≠ an error)."""
+        from ..observe import trace as _telemetry
+
+        if not _telemetry.enabled() and not _telemetry.records():
+            return None
+        if path is None:
+            base = self.trace_dir or _telemetry.run_dir()
+            if base.endswith(".json"):
+                path = base
+            else:
+                path = os.path.join(
+                    base, f"telemetry-{os.getpid()}.trace.json"
+                )
+        return _telemetry.export_chrome_trace(path)
 
     # -- introspection / rank I/O ------------------------------------------
 

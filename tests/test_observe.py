@@ -32,7 +32,9 @@ def test_make_sink_falls_back_offline(tmp_path, monkeypatch):
 
 
 def test_wandb_shim_reference_pattern(tmp_path, monkeypatch):
-    monkeypatch.chdir(tmp_path)
+    # the offline fallback lands under $GRAFT_RUN_DIR (never the cwd —
+    # the old cwd default committed a metrics.jsonl into the repo root)
+    monkeypatch.setenv("GRAFT_RUN_DIR", str(tmp_path))
     monkeypatch.setenv("WANDB_MODE", "disabled")
     wandb.finish()
     assert wandb.login()
@@ -41,7 +43,7 @@ def test_wandb_shim_reference_pattern(tmp_path, monkeypatch):
     wandb.log({"train_loss": 1.0})
     assert wandb.config.epochs == 2
     wandb.finish()
-    assert os.path.exists("metrics.jsonl")
+    assert os.path.exists(tmp_path / "metrics.jsonl")
 
 
 def test_step_timer_summary():
@@ -54,4 +56,6 @@ def test_step_timer_summary():
     s = t.summary()
     assert s["steps"] == 3
     assert 0.005 < s["p50_s"] < 0.1
+    assert s["p99_s"] >= s["p50_s"]
+    assert s["max_s"] >= s["p99_s"]
     assert t.throughput(10) > 0
