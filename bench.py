@@ -343,6 +343,7 @@ _ARM_ENVS = (  # envs that change WHICH arm is being measured
     "GRAFT_BENCH_NORM", "GRAFT_BENCH_SOFTMAX", "GRAFT_BENCH_LOOP",
     "GRAFT_BENCH_SCAN_K", "GRAFT_BENCH_FEED", "GRAFT_BENCH_PREFETCH",
     "GRAFT_REMAT", "GRAFT_SCAN_LAYERS", "GRAFT_WIRE", "GRAFT_FP8",
+    "GRAFT_BENCH_RECOVERY",
 )
 
 
@@ -482,6 +483,126 @@ def _informative_tail(diag: list[str]) -> str:
     )
 
 
+def _recovery_arm() -> None:
+    """Recovery arm (GRAFT_BENCH_RECOVERY=1): measure time_to_recover_s.
+
+    jax-free, pool-free: launches the elastic launcher on the recovery
+    drill (``runtime/recovery_drill.py``) with a fault plan that (a)
+    wedges the step-(K-1) checkpoint write inside the background writer —
+    leaving a torn, uncommitted ``.tmp`` step dir — and (b) SIGKILLs the
+    trainer at step K (``train.preempt``). The launcher classifies the
+    kill as an external termination, shrinks the world to the survivors,
+    and the drill resumes from the last COMMITTED checkpoint, resharding
+    onto the smaller mesh. ``time_to_recover_s`` is first post-resume
+    trained step minus last pre-crash trained step, from the drill's own
+    JSONL event clock.
+    """
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="graft-recovery-")
+    out = os.path.join(workdir, "events.jsonl")
+    ckpt = os.path.join(workdir, "ckpt")
+    crash_step = int(os.environ.get("GRAFT_BENCH_RECOVERY_STEP", "4"))
+    plan = {
+        "faults": [
+            # tear: bg writer for step K-1 sleeps past the kill, so its
+            # .tmp staging dir never commits — the resume must skip it
+            {"site": "ckpt.write", "action": "sleep", "arg": 600,
+             "rank": 0, "attempt": 0, "match": {"step": crash_step - 1}},
+            # preempt: SIGKILL rank 0 at step K's maybe_save
+            {"site": "train.preempt", "action": "kill",
+             "rank": 0, "attempt": 0, "match": {"step": crash_step}},
+        ]
+    }
+    plan_path = os.path.join(workdir, "fault_plan.json")
+    with open(plan_path, "w") as fh:
+        json.dump(plan, fh)
+    env = dict(os.environ)
+    env.update(
+        GRAFT_FAULT_PLAN=plan_path,
+        GRAFT_DRILL_OUT=out,
+        GRAFT_DRILL_CKPT=ckpt,
+        GRAFT_DRILL_STEPS=str(crash_step + 2),
+        GRAFT_LAUNCH_ESCALATE_S="5",
+        GRAFT_RESTART_BACKOFF="0.1",
+        JAX_PLATFORMS="cpu",  # the drill never needs the pool
+        PYTHONUNBUFFERED="1",
+    )
+    if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4"
+        ).strip()
+    from pytorch_distributedtraining_tpu.runtime import recovery_drill
+    cmd = [
+        sys.executable, "-m",
+        "pytorch_distributedtraining_tpu.runtime.launch",
+        "--nproc_per_node=2", "--max_restarts=2",
+        "--elastic", "--min_world=1", recovery_drill.__file__,
+    ]
+    _status(
+        f"recovery arm: tear ckpt@{crash_step - 1}, kill@{crash_step}, "
+        f"elastic 2->? ranks"
+    )
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            cmd, env=env, capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        _emit_error("recovery arm: elastic launcher hung >900s")
+        return
+    wall_s = time.monotonic() - t0
+    if proc.returncode != 0:
+        tail = (proc.stderr or "")[-500:]
+        _emit_error(
+            f"recovery arm: launcher rc={proc.returncode}: {tail}"
+        )
+        return
+    events = []
+    try:
+        with open(out) as fh:
+            events = [json.loads(l) for l in fh if l.strip()]
+    except (OSError, ValueError) as e:
+        _emit_error(f"recovery arm: unreadable event stream: {e}")
+        return
+    steps0 = [e for e in events if e["event"] == "step" and e["attempt"] == 0]
+    resume = next((e for e in events if e["event"] == "resume"), None)
+    if not steps0 or resume is None:
+        _emit_error(
+            f"recovery arm: no crash/resume observed in "
+            f"{len(events)} events (fault plan never fired?)"
+        )
+        return
+    gen = resume["attempt"]
+    first_back = next(
+        (e for e in events if e["event"] == "step" and e["attempt"] == gen),
+        None,
+    )
+    done = next((e for e in events if e["event"] == "done"), None)
+    if first_back is None or done is None:
+        _emit_error("recovery arm: resumed generation produced no steps")
+        return
+    t_last = max(e["t"] for e in steps0)
+    record = {
+        "metric": "time_to_recover_s",
+        "value": round(first_back["t"] - t_last, 3),
+        "unit": "s",
+        "recovery_mode": resume.get("mode") or "retry",
+        "world_from": steps0[0]["world"],
+        "world_to": resume["world"],
+        "mesh_from": steps0[0]["fsdp"],
+        "mesh_to": resume["fsdp"],
+        "crash_step": crash_step,
+        "resume_step": resume["step"],
+        "torn_dirs_skipped": resume.get("torn_dirs", []),
+        "committed_steps": done.get("committed", []),
+        "launcher_wall_s": round(wall_s, 3),
+    }
+    _emit_result(json.dumps(record))
+
+
 def _extract_json_line(lines: list[str]) -> str | None:
     """Last line that parses as the result record, if any."""
     for line in reversed(lines):
@@ -505,6 +626,11 @@ def main() -> None:
     if os.environ.get("_GRAFT_BENCH_PROBE") == "1":
         _unblock_inherited_mask()
         _probe()
+        return
+    if os.environ.get("GRAFT_BENCH_RECOVERY"):
+        # the recovery arm is pool-free (CPU drill through the elastic
+        # launcher) — no probe loop, no TPU claim, its own 900s bound
+        _recovery_arm()
         return
 
     # Hard guarantees: the alarm fires at the self-deadline; SIGTERM from a

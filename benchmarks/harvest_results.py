@@ -41,6 +41,9 @@ STAGES = [
                         "collectives + convergence gate)"),
     ("bench_wire_fp8", "bench.py, GRAFT_WIRE=fp8_e4m3 (block-scaled fp8 "
                        "wire + convergence gate)"),
+    ("recovery", "elastic recovery drill: time_to_recover_s through a "
+                 "torn-checkpoint tear + preemption kill + shrink-to-"
+                 "survive resume (bench.py, GRAFT_BENCH_RECOVERY=1)"),
     ("dispatch_probe", "tunnel dispatch-cost decomposition (dispatch_probe.py)"),
     ("bench_scan_k10", "bench.py, fused + lax.scan k=10 per dispatch"),
     ("bench_scan_k25", "bench.py, fused + lax.scan k=25 per dispatch"),
@@ -94,6 +97,8 @@ ARM_KNOBS = {
     "bench_pp": "GRAFT_PP=4 GRAFT_PP_SCHEDULE=1f1b",
     "bench_wire_int8": "GRAFT_WIRE=int8",
     "bench_wire_fp8": "GRAFT_WIRE=fp8_e4m3",
+    # pool-free robustness arm (unit "s", never an A/B throughput winner)
+    "recovery": "GRAFT_BENCH_RECOVERY=1",
 }
 
 

@@ -194,14 +194,43 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
                   "before the first step")
             raise SystemExit(2)
 
+    # --ckpt: periodic (optionally async) checkpointing with elastic,
+    # reshard-capable auto-resume — a checkpoint written on a different
+    # mesh shape (or world size) restores onto THIS mesh via the portable
+    # manifest (checkpoint_sharded.restore_latest → reshard path)
+    mgr = None
+    if getattr(opt, "ckpt", None):
+        from pytorch_distributedtraining_tpu.checkpoint_sharded import (
+            CheckpointManager,
+        )
+
+        mgr = CheckpointManager(
+            opt.ckpt,
+            save_every=getattr(opt, "save_every", 100),
+            keep=3,
+            async_save=getattr(opt, "ckpt_async", False),
+        )
+        resumed = mgr.restore_latest(jax.tree.map(lambda a: a, state))
+        if resumed is not None:
+            start_step, state = resumed
+            mode = os.environ.get("GRAFT_RECOVERY_MODE", "")
+            print(f"===> Resumed from checkpoint @ step {start_step}"
+                  + (f" (recovery_mode={mode})" if mode else ""))
+
     loss = None
-    for e in range(epochs):
-        for iteration, batch in enumerate(training_dataloader, 1):
-            state, metrics = step(state, batch)
-            loss = metrics["loss"]
-            if iteration % 25 == 0:
-                print(loss)
-        print("For Epoch {}, loss: {:.2f}".format(e, float(loss)))
+    try:
+        for e in range(epochs):
+            for iteration, batch in enumerate(training_dataloader, 1):
+                state, metrics = step(state, batch)
+                loss = metrics["loss"]
+                if mgr is not None:
+                    mgr.maybe_save(int(state.step), state)
+                if iteration % 25 == 0:
+                    print(loss)
+            print("For Epoch {}, loss: {:.2f}".format(e, float(loss)))
+    finally:
+        if mgr is not None:
+            mgr.close()
 
     if telemetry.enabled():
         trace_path = telemetry.export_chrome_trace()
@@ -255,6 +284,17 @@ def main(argv=None):
                              "error additionally aborts on error-severity "
                              "findings (bare --analyze = error; env twin "
                              "$GRAFT_ANALYZE)")
+    parser.add_argument("--ckpt", type=str, default=None,
+                        help="checkpoint root dir: save every --save-every "
+                             "steps and auto-resume (reshard-capable: a "
+                             "checkpoint from a different mesh/world "
+                             "restores onto this one)")
+    parser.add_argument("--ckpt-async", action="store_true",
+                        help="snapshot to host on the step path, serialize "
+                             "in a background writer (commit-marker "
+                             "protocol; see docs/RESILIENCE.md)")
+    parser.add_argument("--save-every", type=int, default=100,
+                        help="checkpoint cadence in steps (with --ckpt)")
     parser.add_argument("--trace", type=str, nargs="?", const="",
                         default=os.environ.get("GRAFT_TRACE"),
                         help="enable unified telemetry (step spans, goodput "

@@ -168,13 +168,21 @@ def validate(val_dataloader, stoke_model: Stoke, epoch):
     return val_avg_loss
 
 
-def save_checkpoint(stoke_model, epoch, train_loss, val_loss):
+def save_checkpoint(stoke_model, epoch, train_loss, val_loss,
+                    portable_dir=None):
     os.makedirs("checkpoint/", exist_ok=True)
     path, tag = stoke_model.save(
         path="checkpoint/",
         name="model_{}_{:.2f}_{:.2f}".format(epoch, train_loss, val_loss),
     )
     print("Checkpoint saved after epoch {}".format(epoch))
+    if portable_dir:
+        # topology-independent twin: restores onto a different mesh/world
+        # via Stoke.load_resharded (elastic resume, docs/RESILIENCE.md)
+        p = stoke_model.save_portable(
+            os.path.join(portable_dir, "epoch_{:04d}".format(epoch))
+        )
+        print("Portable (reshardable) checkpoint saved: {}".format(p))
     return path, tag
 
 
@@ -197,6 +205,12 @@ def build_parser():
     parser.add_argument("--synthetic-n", type=int, default=256)
     parser.add_argument("--pretrained", type=str, default=None,
                         help="checkpoint to load (nested 'params' key supported)")
+    parser.add_argument("--portable-ckpt", type=str, default=None,
+                        help="also write a topology-independent (portable) "
+                             "checkpoint per epoch under DIR, and auto-"
+                             "resume from the latest committed one — "
+                             "reshards onto this run's mesh even if saved "
+                             "on a different mesh/world size")
     parser.add_argument("--fp16", type=str, default=None, choices=[None, "amp", "bf16"],
                         help="precision: amp (fp16+scaler) or bf16")
     parser.add_argument("--scan-layers", action="store_true",
@@ -438,13 +452,34 @@ def main(argv=None):
     wandb.init(project=opt.projectName, config=config, reinit=True)
     config = wandb.config
 
+    # elastic resume: latest COMMITTED portable checkpoint (torn .tmp dirs
+    # and marker-less dirs are never candidates), resharded onto this mesh
+    if opt.portable_ckpt and os.path.isdir(opt.portable_ckpt):
+        from pytorch_distributedtraining_tpu.checkpoint_sharded import (
+            is_committed_dir,
+        )
+
+        cands = sorted(
+            os.path.join(opt.portable_ckpt, d)
+            for d in os.listdir(opt.portable_ckpt)
+        )
+        latest = next(
+            (p for p in reversed(cands) if is_committed_dir(p)), None
+        )
+        if latest is not None:
+            stoke_model.init(np.zeros((1, 32, 32, 3), np.float32))
+            stoke_model.load_resharded(latest)
+            print("===> Resumed portable checkpoint {} (resharded onto "
+                  "this mesh)".format(latest))
+
     print("===> Training")
     train_loss = val_loss = float("nan")
     for epoch in tqdm(range(epochs), leave=True):
         train_loss = train(train_dataloader, stoke_model, scheduler1, scheduler2, epoch)
         val_loss = validate(val_dataloader, stoke_model, epoch)
         scheduler1.lr_scale = scheduler2.step(val_loss)  # fixed: :84 never fired
-        save_checkpoint(stoke_model, epoch, train_loss, val_loss)
+        save_checkpoint(stoke_model, epoch, train_loss, val_loss,
+                        portable_dir=opt.portable_ckpt)
 
         print("--------Train Loss after Epoch {} - {} --------".format(epoch, train_loss))
         print("--------Val Loss after Epoch {} - {} --------".format(epoch, val_loss))

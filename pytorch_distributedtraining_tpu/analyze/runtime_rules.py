@@ -48,3 +48,65 @@ def bench_telemetry(ctx):
             + ("loaded but disabled" if tr is not None else "never imported")
         ),
     )
+
+
+def _ckpt_stats():
+    """checkpoint_sharded.runtime_stats via sys.modules — never imported
+    (the checkpoint layer pulls in jax; this plane must stay jax-free)."""
+    ck = sys.modules.get("pytorch_distributedtraining_tpu.checkpoint_sharded")
+    return getattr(ck, "runtime_stats", None)
+
+
+@rule(
+    "ckpt-commits-silent",
+    "runtime",
+    "checkpoint saves initiated but no commit marker ever observed",
+)
+def ckpt_commits_silent(ctx):
+    stats = _ckpt_stats()
+    if stats is None or stats.get("save_every") is None:
+        return
+    if stats.get("saves_initiated", 0) > 0 and not stats.get(
+        "commits_observed", 0
+    ):
+        err = stats.get("last_write_error")
+        yield Finding(
+            "ckpt-commits-silent",
+            Severity.WARN,
+            "runtime:checkpoint",
+            "checkpoint saves were initiated but NO commit marker landed: "
+            "the async writer is silently dead (or every write is torn), "
+            "so a preemption right now would resume from nothing. Check "
+            "disk space / the writer's last error and call "
+            "CheckpointManager.wait() to force the drain",
+            evidence=(
+                f"saves_initiated={stats.get('saves_initiated')} "
+                f"commits_observed=0"
+                + (f" last_write_error={err!r}" if err else "")
+            ),
+        )
+
+
+@rule(
+    "ckpt-manifest-mismatch",
+    "runtime",
+    "resume template's leaf shapes disagree with the checkpoint manifest",
+)
+def ckpt_manifest_mismatch(ctx):
+    stats = _ckpt_stats()
+    if not stats:
+        return
+    mismatches = stats.get("manifest_mismatches") or []
+    if not mismatches:
+        return
+    yield Finding(
+        "ckpt-manifest-mismatch",
+        Severity.ERROR,
+        "runtime:checkpoint",
+        f"{len(mismatches)} template leaf(s) disagree with the checkpoint "
+        "manifest (shape/dtype): the restore is loading a DIFFERENT model "
+        "than was saved — a resumed run would train from silently corrupt "
+        "state. Fix the template (model config / scan layout / precision) "
+        "to match the manifest, or point at the right checkpoint",
+        evidence="; ".join(str(m) for m in mismatches[:3]),
+    )

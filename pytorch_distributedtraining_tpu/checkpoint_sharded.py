@@ -9,29 +9,69 @@ no sharded format, recovery = manual ``--start-epoch``
 - :func:`save_sharded` / :func:`restore_sharded` — orbax-backed, every
   process writes its own shards (no consolidation OOM), restore places
   arrays directly into the caller's NamedShardings.
+- the **portable format** — :func:`save_portable` /
+  :func:`restore_portable` / :func:`reshard_restore` — a
+  topology-independent layout (per-rank shard files + a manifest of
+  per-leaf global shape/dtype/logical axes) with an explicit
+  commit-marker protocol: everything lands in ``<step dir>.tmp``, each
+  file is fsynced, a ``_COMMIT`` marker is written last, and the tmp dir
+  is atomically renamed into place. A kill at ANY point mid-write leaves
+  either a ``*.tmp`` dir or a marker-less dir — both provably skipped by
+  :meth:`CheckpointManager.restore_latest`. Because restore re-places
+  full global arrays onto the *template's* shardings, a checkpoint taken
+  on one mesh re-homes onto any other mesh shape (dp/fsdp N→M, ZeRO
+  moments included), and :func:`reshard_restore` additionally converts
+  scan/pp *stacked* layouts to loop layouts and back
+  (``parallel/reshard.py``, generalizing ``models/scan_utils.py``).
 - :class:`CheckpointManager` — save-every-N-steps with keep-last-k GC,
   latest-checkpoint discovery for auto-resume, and a SIGTERM/preemption
-  hook that forces a save at the next step boundary (TPU pods get
-  preempted; the reference's answer was a W&B retry loop,
-  `Stoke-DDP.py:316-322`).
+  hook that forces a save at the next step boundary. With
+  ``async_save=True`` the step path pays only the device→host snapshot
+  (a donation-safe copy, bounded by ``GRAFT_CKPT_HOST_BUDGET_MB``); a
+  background writer thread serializes and commits off the step path, and
+  in-flight writes are drained (``wait()``) on preemption agreement.
 """
 
 from __future__ import annotations
 
+import glob
+import json
 import os
+import queue
 import re
 import shutil
 import signal
 import threading
+import time
 from typing import Any
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .observe import trace as telemetry
 from .resilience.faults import fault_point
 from .resilience.outage import OutageClass, RetryPolicy, classify_exception
+
+PORTABLE_FORMAT = "graft-portable-ckpt"
+PORTABLE_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+COMMIT_MARKER = "_COMMIT"
+
+# Live-process counters the graftcheck runtime plane reads
+# (analyze/runtime_rules.py): a run that initiated saves but never
+# observed a commit has a silently-dead async writer; a restore whose
+# template disagreed with the manifest is recorded here so the analyzer
+# can surface it as an ERROR with the offending leaves named.
+runtime_stats: dict = {
+    "save_every": None,
+    "saves_initiated": 0,
+    "commits_observed": 0,
+    "last_snapshot_s": None,
+    "last_write_error": None,
+    "manifest_mismatches": [],
+}
 
 
 def _abs(path: str) -> str:
@@ -96,12 +136,436 @@ def restore_sharded(path: str, template: Any) -> Any:
         return ckptr.restore(path, abstract)
 
 
+# -- portable (topology-independent) format ------------------------------
+
+
+def _spec_to_json(sharding) -> list | None:
+    """PartitionSpec -> json-able per-dim axis names (None|str|[str...])."""
+    if not isinstance(sharding, NamedSharding):
+        return None
+    out = []
+    for entry in tuple(sharding.spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(a) for a in entry])
+        else:
+            out.append(str(entry))
+    return out
+
+
+def _norm_index(index, shape) -> list:
+    """A shard's index (tuple of slices) as [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+class _HostSnapshot:
+    """A donation-safe host copy of one state pytree.
+
+    ``leaves`` is ordered like ``jax.tree_util.tree_flatten_with_path``;
+    each entry is ``(path_str, shape, dtype_str, spec, shards)`` where
+    ``shards`` is a list of ``(index, np.ndarray)`` covering this
+    process's addressable, replica-0 pieces of the global array.
+    """
+
+    def __init__(self, state: Any):
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        self.leaves = []
+        self.nbytes = 0
+        for path, leaf in flat:
+            pstr = jax.tree_util.keystr(path)
+            sharding = getattr(leaf, "sharding", None)
+            spec = _spec_to_json(sharding)
+            if hasattr(leaf, "addressable_shards"):
+                shape = tuple(leaf.shape)
+                dtype = str(leaf.dtype)
+                shards = []
+                for sh in leaf.addressable_shards:
+                    if sh.replica_id != 0:
+                        continue
+                    # explicit copy: the train loop may donate this very
+                    # buffer into the next step the moment save() returns
+                    arr = np.array(sh.data, copy=True)
+                    shards.append((_norm_index(sh.index, shape), arr))
+                    self.nbytes += arr.nbytes
+            else:  # plain numpy / python scalar leaf
+                arr = np.array(leaf, copy=True)
+                shape, dtype = tuple(arr.shape), str(arr.dtype)
+                shards = [(_norm_index((slice(None),) * arr.ndim, shape),
+                           arr)]
+                self.nbytes += arr.nbytes
+            self.leaves.append((pstr, shape, dtype, spec, shards))
+
+    def manifest(self, step: int | None = None) -> dict:
+        return {
+            "format": PORTABLE_FORMAT,
+            "version": PORTABLE_VERSION,
+            "step": step,
+            "world_size": jax.process_count(),
+            "leaves": {
+                p: {"shape": list(shape), "dtype": dtype, "spec": spec}
+                for p, shape, dtype, spec, _ in self.leaves
+            },
+        }
+
+
+def snapshot_to_host(state: Any) -> _HostSnapshot:
+    """Device→host copy of ``state`` (the only on-step-path cost of an
+    async save). Timed under a ``checkpoint`` span so the goodput ledger
+    bills it, and recorded in ``runtime_stats`` for the overhead test."""
+    t0 = time.perf_counter()
+    with telemetry.span("checkpoint.snapshot", "checkpoint"):
+        snap = _HostSnapshot(state)
+    runtime_stats["last_snapshot_s"] = time.perf_counter() - t0
+    return snap
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - platform without dir fds
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_rank_shards(tmp_dir: str, snap: _HostSnapshot, rank: int) -> None:
+    """This process's shard payload + sidecar into the tmp dir.
+
+    The ``.json`` sidecar is written (and fsynced) AFTER the ``.npz`` —
+    its presence is the per-rank "my payload is durable" marker the
+    rank-0 committer waits for.
+    """
+    arrays: dict = {}
+    entries = []
+    for i, (pstr, _shape, _dtype, _spec, shards) in enumerate(snap.leaves):
+        for j, (index, arr) in enumerate(shards):
+            key = f"L{i}_S{j}"
+            arrays[key] = arr
+            entries.append({"key": key, "leaf": pstr, "index": index})
+    npz = os.path.join(tmp_dir, f"shards_r{rank}.npz")
+    np.savez(npz, **arrays)
+    _fsync_file(npz)
+    sidecar = os.path.join(tmp_dir, f"shards_r{rank}.json")
+    with open(sidecar, "w", encoding="utf-8") as fh:
+        json.dump({"rank": rank, "entries": entries}, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def _commit_portable(
+    tmp_dir: str, final_dir: str, world_size: int, step: int | None,
+) -> None:
+    """Rank-0 commit: wait for every rank's sidecar, write the marker,
+    fsync, atomically rename ``<step>.tmp`` -> ``<step>``."""
+    deadline = time.monotonic() + float(
+        os.environ.get("GRAFT_CKPT_COMMIT_TIMEOUT", "120")
+    )
+    while True:
+        have = len(glob.glob(os.path.join(tmp_dir, "shards_r*.json")))
+        if have >= world_size:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"checkpoint commit: only {have}/{world_size} rank payloads "
+                f"arrived in {tmp_dir} — leaving the dir torn (un-renamed)"
+            )
+        time.sleep(0.05)
+    marker = os.path.join(tmp_dir, COMMIT_MARKER)
+    with open(marker, "w", encoding="utf-8") as fh:
+        json.dump({"step": step, "t": time.time(), "ranks": world_size}, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    _fsync_dir(tmp_dir)
+    os.rename(tmp_dir, final_dir)
+    _fsync_dir(os.path.dirname(final_dir) or ".")
+    runtime_stats["commits_observed"] += 1
+    telemetry.instant("ckpt.commit", "checkpoint", path=final_dir, step=step)
+
+
+def write_portable(
+    path: str, snap: _HostSnapshot, *, step: int | None = None,
+) -> str:
+    """Serialize a host snapshot with the commit-marker protocol.
+
+    Every process writes its own shard payload into ``<path>.tmp``;
+    process 0 writes the manifest, waits for all payloads, writes the
+    ``_COMMIT`` marker and renames. A kill anywhere in here leaves a
+    ``*.tmp`` dir :meth:`CheckpointManager.all_steps` never matches.
+    """
+    path = _abs(path)
+    tmp_dir = path + ".tmp"
+    rank = jax.process_index()
+    world = jax.process_count()
+    os.makedirs(tmp_dir, exist_ok=True)
+    # chaos site: kill/delay INSIDE the background writer — this is how
+    # the chaos matrix manufactures torn checkpoint dirs
+    fault_point("ckpt.write", path=path, step=step, rank=rank)
+    if rank == 0:
+        man = os.path.join(tmp_dir, MANIFEST_NAME)
+        with open(man, "w", encoding="utf-8") as fh:
+            json.dump(snap.manifest(step), fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+    _write_rank_shards(tmp_dir, snap, rank)
+    if rank == 0:
+        _commit_portable(tmp_dir, path, world, step)
+    return path
+
+
+def save_portable(path: str, state: Any, *, step: int | None = None) -> str:
+    """Synchronous snapshot + portable write (commit protocol included)."""
+    runtime_stats["saves_initiated"] += 1
+    snap = snapshot_to_host(state)
+    with telemetry.span("checkpoint.write", "checkpoint", path=path):
+        return write_portable(path, snap, step=step)
+
+
+def is_portable_dir(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, MANIFEST_NAME))
+
+
+def is_committed_dir(path: str) -> bool:
+    """A complete portable checkpoint: manifest + commit marker, and not
+    a ``*.tmp`` staging dir."""
+    return (
+        not path.rstrip(os.sep).endswith(".tmp")
+        and is_portable_dir(path)
+        and os.path.isfile(os.path.join(path, COMMIT_MARKER))
+    )
+
+
+def read_manifest(path: str) -> dict:
+    with open(os.path.join(_abs(path), MANIFEST_NAME), encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _assemble_host_tree(path: str) -> tuple[dict, dict]:
+    """(manifest, {leaf path -> full global np.ndarray}) from a committed
+    portable dir — shard pieces from every rank placed by global index."""
+    path = _abs(path)
+    manifest = read_manifest(path)
+    leaves = manifest["leaves"]
+    out: dict = {}
+    for sidecar in sorted(glob.glob(os.path.join(path, "shards_r*.json"))):
+        with open(sidecar, encoding="utf-8") as fh:
+            meta = json.load(fh)
+        npz = np.load(sidecar[: -len(".json")] + ".npz")
+        for entry in meta["entries"]:
+            pstr = entry["leaf"]
+            info = leaves[pstr]
+            if pstr not in out:
+                out[pstr] = np.empty(
+                    tuple(info["shape"]), dtype=np.dtype(info["dtype"])
+                )
+            idx = tuple(slice(a, b) for a, b in entry["index"])
+            out[pstr][idx] = npz[entry["key"]]
+    missing = set(leaves) - set(out)
+    if missing:
+        raise ValueError(
+            f"portable checkpoint {path} is missing shard data for "
+            f"{sorted(missing)[:5]}{'...' if len(missing) > 5 else ''}"
+        )
+    return manifest, out
+
+
+def _record_mismatch(msg: str) -> None:
+    runtime_stats["manifest_mismatches"].append(msg)
+
+
+def _target_sharding(leaf, target_mesh) -> NamedSharding | None:
+    """The sharding to place a restored leaf onto: the template leaf's own
+    NamedSharding re-homed onto ``target_mesh`` (shardings are metadata —
+    the same logical axes apply to any mesh shape that carries them)."""
+    sharding = getattr(leaf, "sharding", None)
+    if target_mesh is None:
+        return sharding if isinstance(sharding, NamedSharding) else None
+    if isinstance(sharding, NamedSharding):
+        if sharding.mesh is target_mesh:
+            return sharding
+        spec = tuple(
+            entry if (
+                entry is None
+                or all(
+                    target_mesh.shape.get(a, 1) >= 1
+                    and a in target_mesh.axis_names
+                    for a in ((entry,) if isinstance(entry, str) else entry)
+                )
+            ) else None
+            for entry in tuple(sharding.spec)
+        )
+        return NamedSharding(target_mesh, P(*spec))
+    return NamedSharding(target_mesh, P())
+
+
+def reshard_restore(path: str, target_mesh, template: Any) -> Any:
+    """Restore a portable checkpoint onto a (possibly different) mesh.
+
+    ``template`` gives the target structure, shapes/dtypes and logical
+    axes (a pytree of jax.Arrays or ShapeDtypeStructs with shardings);
+    ``target_mesh`` is the mesh to re-home those shardings onto (pass
+    ``None`` to trust the template's own shardings). Handles:
+
+    - dp/fsdp/ZeRO N→M: full global arrays are re-placed shard-by-shard
+      onto the template's NamedShardings via ``make_array_from_callback``
+      (works single- and multi-process).
+    - pp-stacked / scan-stacked leaves: same re-placement (the global
+      ``[L, ...]`` shape is topology-independent), plus layout
+      *conversion* when the template's tree uses the loop layout
+      (``h_0..h_{n-1}``) and the checkpoint the stacked one, or vice
+      versa (``parallel/reshard.py``).
+
+    A template leaf whose shape/dtype disagrees with the manifest raises
+    ``ValueError`` naming the leaves, and records the mismatch in
+    ``runtime_stats`` for the graftcheck runtime plane.
+    """
+    from .parallel.reshard import convert_layout
+
+    path = _abs(path)
+    manifest, host = _assemble_host_tree(path)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    target_paths = [jax.tree_util.keystr(p) for p, _ in flat]
+    want = {
+        jax.tree_util.keystr(p): (
+            tuple(np.shape(leaf)) if not hasattr(leaf, "shape")
+            else tuple(leaf.shape),
+            np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype)),
+        )
+        for p, leaf in flat
+    }
+    host = convert_layout(host, target_paths, want)
+    problems = []
+    for pstr in target_paths:
+        if pstr not in host:
+            problems.append(f"{pstr}: absent from checkpoint manifest")
+            continue
+        shape, dtype = want[pstr]
+        arr = host[pstr]
+        if tuple(arr.shape) != shape or arr.dtype != dtype:
+            problems.append(
+                f"{pstr}: checkpoint {tuple(arr.shape)}/{arr.dtype} vs "
+                f"template {shape}/{dtype}"
+            )
+    if problems:
+        for p in problems:
+            _record_mismatch(p)
+        raise ValueError(
+            "reshard_restore: template disagrees with checkpoint manifest "
+            f"({path}): " + "; ".join(problems[:5])
+            + ("..." if len(problems) > 5 else "")
+        )
+    values = []
+    for (p, leaf), pstr in zip(flat, target_paths):
+        arr = host[pstr]
+        sharding = _target_sharding(leaf, target_mesh)
+        if sharding is None:
+            values.append(arr)
+            continue
+        values.append(
+            jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx, a=arr: a[idx]
+            )
+        )
+    return jax.tree_util.tree_unflatten(treedef, values)
+
+
+def restore_portable(path: str, template: Any) -> Any:
+    """Restore a portable checkpoint using the template's own shardings
+    (same-topology resume; :func:`reshard_restore` with no re-homing)."""
+    return reshard_restore(path, None, template)
+
+
+# -- background writer ----------------------------------------------------
+
+
+class _AsyncWriter:
+    """One daemon thread serializing host snapshots off the step path.
+
+    At most one write is in flight (``save()`` drains the previous one
+    first — bounded host RAM, bounded staleness). A failed write leaves
+    its torn ``.tmp`` dir on disk (that is the crash-consistency story,
+    not a bug) and surfaces the error on the next ``wait()`` caller via
+    ``runtime_stats`` + stderr, without killing the training process.
+    """
+
+    def __init__(self):
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._idle = threading.Event()
+        self._idle.set()
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            path, snap, step = item
+            try:
+                with telemetry.span(
+                    "checkpoint.write.bg", "checkpoint", path=path
+                ):
+                    write_portable(path, snap, step=step)
+            except BaseException as e:  # noqa: BLE001 - must not die silently
+                runtime_stats["last_write_error"] = f"{type(e).__name__}: {e}"
+                import sys as _sys
+
+                print(
+                    f"[ckpt] background write of {path} failed "
+                    f"({type(e).__name__}: {e}); torn dir left for "
+                    f"restore_latest to skip",
+                    file=_sys.stderr,
+                    flush=True,
+                )
+            finally:
+                self._idle.set()
+
+    @property
+    def in_flight(self) -> bool:
+        return not self._idle.is_set()
+
+    def submit(self, path: str, snap: _HostSnapshot, step: int) -> None:
+        self.drain()
+        self._idle.clear()
+        self._q.put((path, snap, step))
+
+    def drain(self) -> None:
+        self._idle.wait()
+
+    def close(self) -> None:
+        if self._thread.is_alive():
+            self.drain()
+            self._q.put(None)
+            self._thread.join(timeout=30.0)
+
+
 class CheckpointManager:
     """Step-based run checkpointing with GC, resume, and preemption save.
 
-    Layout: ``<root>/step_<N>/`` orbax directories. ``latest_step()`` finds
-    the newest complete checkpoint; ``maybe_save`` writes every
-    ``save_every`` steps — or immediately when a preemption signal arrived.
+    Layout: ``<root>/step_<N>/`` portable dirs (commit-marker protocol;
+    pre-existing orbax dirs still restore). ``latest_step()`` finds the
+    newest COMMITTED checkpoint — a ``*.tmp`` staging dir or a
+    marker-less dir from a mid-write kill is never a resume source.
+    ``maybe_save`` writes every ``save_every`` steps — or immediately
+    when a preemption signal arrived anywhere, draining the in-flight
+    async write so the save is durable before the job dies.
     """
 
     def __init__(
@@ -112,20 +576,31 @@ class CheckpointManager:
         keep: int = 3,
         handle_sigterm: bool = True,
         async_save: bool = False,
+        host_budget_mb: float | None = None,
     ):
         self.root = _abs(root)
         self.save_every = int(save_every)
         self.keep = int(keep)
         self._preempted = threading.Event()
         self._prev_handler = None
-        # async_save: ``save()`` returns once the device→host copy is done
-        # (orbax's async contract) and the disk write proceeds in the
-        # background — the train loop continues immediately, and donated
+        # async_save: ``save()`` returns once the device→host snapshot is
+        # done — the train loop continues immediately, and donated
         # next-step buffers are safe because the data already left the
-        # device. At most one save is in flight (back-pressure on the next
-        # save, not an unbounded queue).
+        # device. At most one save is in flight (back-pressure on the
+        # next save, not an unbounded queue), and a snapshot larger than
+        # the host-RAM budget degrades to a synchronous write instead of
+        # doubling peak host memory.
         self.async_save = bool(async_save)
-        self._async_ckptr = ocp.StandardCheckpointer() if async_save else None
+        self.host_budget_bytes = int(
+            float(
+                host_budget_mb
+                if host_budget_mb is not None
+                else os.environ.get("GRAFT_CKPT_HOST_BUDGET_MB", "4096")
+            )
+            * 1024 * 1024
+        )
+        self._writer = _AsyncWriter() if async_save else None
+        runtime_stats["save_every"] = self.save_every
         os.makedirs(self.root, exist_ok=True)
         if handle_sigterm and threading.current_thread() is threading.main_thread():
             self._prev_handler = signal.signal(signal.SIGTERM, self._on_sigterm)
@@ -151,10 +626,13 @@ class CheckpointManager:
         for name in os.listdir(self.root):
             m = re.fullmatch(r"step_(\d+)", name)
             d = os.path.join(self.root, name)
-            # orbax writes atomically (tmp dir + rename): an exactly-named
-            # step dir with content is a complete checkpoint
-            if m and os.path.isdir(d) and os.listdir(d):
-                steps.append(int(m.group(1)))
+            if not (m and os.path.isdir(d) and os.listdir(d)):
+                continue
+            if is_portable_dir(d) and not is_committed_dir(d):
+                continue  # torn portable dir: manifest but no _COMMIT
+            # legacy orbax dirs carry no marker; orbax writes atomically
+            # (tmp dir + rename), so exact-named content is complete
+            steps.append(int(m.group(1)))
         return sorted(steps)
 
     def latest_step(self) -> int | None:
@@ -163,41 +641,52 @@ class CheckpointManager:
 
     # -- save/restore ------------------------------------------------------
 
+    @property
+    def in_flight(self) -> bool:
+        """True while a background write has not yet committed."""
+        return self._writer is not None and self._writer.in_flight
+
     def save(self, step: int, state: Any) -> str:
-        if self._async_ckptr is not None:
-            # previous in-flight save (if any) finishes first, and only
-            # COMPLETE checkpoints are GC'd before the new one starts
-            self._async_ckptr.wait_until_finished()
+        path = self._step_dir(step)
+        # same chaos site as save_sharded: transient I/O at initiation
+        fault_point("checkpoint.write", path=path)
+        runtime_stats["saves_initiated"] += 1
+        if self._writer is not None:
+            # previous in-flight write finishes first (bounded host RAM),
+            # and only COMPLETE checkpoints are GC'd before the new one
+            self._writer.drain()
             self._gc()
-            path = self._step_dir(step)
-            # same chaos site as the sync path; async initiation errors
-            # surface here, commit errors at wait_until_finished
-            fault_point("checkpoint.write", path=path)
-            # the span covers only save *initiation*: the async write's
-            # body overlaps training by design and must not be billed as
-            # checkpoint wall time (wait() below carries the blocking tail)
-            with telemetry.span(
-                "checkpoint.write.async", "checkpoint", path=path
-            ):
-                self._async_ckptr.save(path, state, force=True)
+            snap = snapshot_to_host(state)
+            if snap.nbytes > self.host_budget_bytes:
+                # over budget: one copy already exists; holding it behind
+                # a queue buys nothing, so write it out synchronously
+                with telemetry.span(
+                    "checkpoint.write", "checkpoint", path=path
+                ):
+                    write_portable(path, snap, step=step)
+                return path
+            self._writer.submit(path, snap, step)
             return path
-        path = save_sharded(self._step_dir(step), state, force=True)
+        snap = snapshot_to_host(state)
+        with telemetry.span("checkpoint.write", "checkpoint", path=path):
+            write_portable(path, snap, step=step)
         self._gc()
         return path
 
     def wait(self) -> None:
-        """Block until any in-flight async save has fully landed on disk."""
-        if self._async_ckptr is not None:
+        """Block until any in-flight async write has fully landed on disk."""
+        if self._writer is not None:
             with telemetry.span("checkpoint.wait", "checkpoint"):
-                self._async_ckptr.wait_until_finished()
+                self._writer.drain()
             self._gc()  # the save that just landed now counts toward keep
 
     def _preempted_anywhere(self) -> bool:
         """Agree the (per-process) SIGTERM flag across all hosts.
 
-        ``save_sharded`` is a collective: if only the signalled host entered
-        it, the job would deadlock. Every process calls this each step, so
-        the tiny allgather doubles as the agreement point.
+        The portable commit is rank-0's rename: if only the signalled host
+        drained its writer, the job could die with rank payloads missing.
+        Every process calls this each step, so the tiny allgather doubles
+        as the agreement point.
         """
         local = self._preempted.is_set()
         if jax.process_count() == 1:
@@ -237,12 +726,23 @@ class CheckpointManager:
         return None
 
     def restore_latest(self, template: Any) -> tuple[int, Any] | None:
-        """(step, state) from the newest checkpoint, or None if fresh run."""
+        """(step, state) from the newest COMMITTED checkpoint, or None.
+
+        Torn dirs — ``step_N.tmp`` staging dirs and marker-less portable
+        dirs from a mid-write kill — are skipped, never crashed on: the
+        commit protocol guarantees anything ``all_steps`` returns is
+        complete. The portable restore places global arrays onto the
+        template's shardings, so the template may live on a different
+        mesh shape than the one that saved (elastic shrink resume).
+        """
         self.wait()  # an in-flight async save may be the latest
         step = self.latest_step()
         if step is None:
             return None
-        return step, restore_sharded(self._step_dir(step), template)
+        path = self._step_dir(step)
+        if is_portable_dir(path):
+            return step, restore_portable(path, template)
+        return step, restore_sharded(path, template)
 
     def _gc(self) -> None:
         if jax.process_index() != 0:
@@ -250,12 +750,19 @@ class CheckpointManager:
         steps = self.all_steps()
         for s in steps[: -self.keep] if self.keep > 0 else []:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        if steps:
+            # torn staging dirs below the newest commit are dead (at most
+            # one write is in flight, and it is always the newest step)
+            for tmp in glob.glob(os.path.join(self.root, "step_*.tmp")):
+                m = re.fullmatch(r"step_(\d+)\.tmp", os.path.basename(tmp))
+                if m and int(m.group(1)) < steps[-1]:
+                    shutil.rmtree(tmp, ignore_errors=True)
 
     def close(self) -> None:
-        if self._async_ckptr is not None:
+        if self._writer is not None:
             self.wait()
-            self._async_ckptr.close()
-            self._async_ckptr = None
+            self._writer.close()
+            self._writer = None
         if self._prev_handler is not None:
             signal.signal(signal.SIGTERM, self._prev_handler)
             self._prev_handler = None

@@ -18,11 +18,16 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 
-def stack_trees(trees):
-    """Stack a list of identical-structure pytrees leaf-wise (new axis 0)."""
+def stack_trees(trees, xp=jnp):
+    """Stack a list of identical-structure pytrees leaf-wise (new axis 0).
+
+    ``xp`` selects the array namespace (``jnp`` default; pass ``numpy``
+    for host-side use — the checkpoint reshard path converts layouts on
+    host arrays before any device placement happens).
+    """
     if not trees:
         raise ValueError("stack_trees needs at least one tree")
-    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+    return jax.tree.map(lambda *xs: xp.stack(xs), *trees)
 
 
 def unstack_tree(tree, n: int):
@@ -30,10 +35,13 @@ def unstack_tree(tree, n: int):
     return [jax.tree.map(lambda x: x[i], tree) for i in range(n)]
 
 
-def stack_layer_params(params: dict, prefix: str, n: int, dest: str) -> dict:
+def stack_layer_params(
+    params: dict, prefix: str, n: int, dest: str, xp=jnp
+) -> dict:
     """Loop layout -> scan layout: fold ``{prefix}{i}`` subtrees into one
     stacked ``dest`` subtree (leading axis ``n``). Non-layer keys pass
-    through untouched; returns a new dict.
+    through untouched; returns a new dict. ``xp`` as in
+    :func:`stack_trees`.
     """
     out = dict(params)
     layers = []
@@ -45,7 +53,7 @@ def stack_layer_params(params: dict, prefix: str, n: int, dest: str) -> dict:
                 f"{sorted(k for k in out if k.startswith(prefix))})"
             )
         layers.append(out.pop(key))
-    out[dest] = stack_trees(layers)
+    out[dest] = stack_trees(layers, xp=xp)
     return out
 
 

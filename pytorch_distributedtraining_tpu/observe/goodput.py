@@ -14,7 +14,14 @@ launcher import nothing heavier):
   ``productive / compile / input_wait / checkpoint / collective /
   outage / other``. Per-bucket interval *union* (not naive sums), so a
   ``StepTimer`` span folded over a ``TrainStep`` dispatch span cannot
-  double-count; only top-level (depth-0) spans participate.
+  double-count; only top-level (depth-0) spans participate — and only
+  on the busiest thread. That single-tid rule is ALSO the async-
+  checkpoint accounting contract (``checkpoint_sharded``): the
+  background writer's ``checkpoint.write.bg`` spans live on their own
+  thread and are deliberately NOT billed (the write overlaps training,
+  off the step path by design), while the main thread's
+  ``checkpoint.snapshot`` / ``checkpoint.wait`` spans — the part the
+  step actually pays — land in the ``checkpoint`` bucket.
 - analytic per-model training FLOPs for the three flagship models
   (GPT-2, ViT, SwinIR) straight from their configs — fwd+bwd as 3x
   forward, the standard estimate — and :func:`mfu` against

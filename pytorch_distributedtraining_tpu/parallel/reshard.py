@@ -1,0 +1,117 @@
+"""Checkpoint layout conversion for topology-independent restore.
+
+A checkpoint's tree layout encodes compile-time choices that have nothing
+to do with the weights themselves: ``nn.scan`` stores N repeated blocks
+as ONE stacked subtree (``h`` with leading axis N), the loop path stores
+``h_0..h_{N-1}``; the pipeline engine stacks per-stage layers the same
+way (``parallel/pipeline.py pipeline_state_shardings`` re-homes those
+``[L, ...]`` leaves to ``P("pp")``). ``models/scan_utils.py`` converts
+between the two layouts for live params; this module generalizes the
+same stack/unstack algebra to the *host-side* restore path
+(``checkpoint_sharded.reshard_restore``), where leaves are plain numpy
+arrays keyed by flattened tree paths — so a checkpoint saved scanned
+(or pp-stacked) restores into a loop-layout template and vice versa,
+independent of the mesh it was saved on.
+
+Pure stdlib + numpy: importable from the checkpoint layer without
+dragging model code in.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+# "...['h_3']..." -> family "...['h']..." at stacked index 3
+_IDX_SEG = re.compile(r"\['([A-Za-z0-9_]*?)_(\d+)'\]")
+
+
+def _family_candidates(path: str):
+    """Every (stacked_path, index) this loop-layout path could unstack
+    from: each ``['name_i']`` segment replaced by ``['name']``."""
+    for m in _IDX_SEG.finditer(path):
+        stacked = path[: m.start()] + f"['{m.group(1)}']" + path[m.end():]
+        yield stacked, int(m.group(2)), m
+    # bare trailing index like ['3'] (list-of-layers trees)
+    for m in re.finditer(r"\['?(\d+)'?\]", path):
+        stacked = path[: m.start()] + path[m.end():]
+        if stacked:
+            yield stacked, int(m.group(1)), m
+
+
+def _stacked_members(host: dict, path: str, m: re.Match) -> list | None:
+    """For a target *stacked* path built from segment ``m`` of a member
+    path, collect the full ``name_0..name_{L-1}`` family in order."""
+    prefix, suffix = path[: m.start()], path[m.end():]
+    name = m.group(1)
+    members = []
+    i = 0
+    while True:
+        candidate = f"{prefix}['{name}_{i}']{suffix}"
+        if candidate not in host:
+            break
+        members.append(candidate)
+        i += 1
+    return members or None
+
+
+def convert_layout(host: dict, target_paths: list, want: dict) -> dict:
+    """Re-key a restored host tree onto the template's layout.
+
+    ``host`` maps checkpoint leaf paths (``jax.tree_util.keystr`` form) to
+    full global numpy arrays; ``target_paths`` lists the template's leaf
+    paths; ``want`` maps each target path to its ``(shape, dtype)``.
+    Paths already present pass through untouched. For each missing path:
+
+    - **unstack** (scan/pp-stacked ckpt → loop template): a target
+      ``...['h_3']...`` is sliced from a checkpoint ``...['h']...`` whose
+      leading axis covers index 3 and whose trailing shape matches.
+    - **stack** (loop ckpt → scanned template): a target ``...['h']...``
+      expecting ``[L, ...]`` is ``np.stack``-ed from checkpoint
+      ``...['h_0']... .. ...['h_{L-1}']...`` when all L members exist
+      with the member shape.
+
+    Returns a NEW dict; unconvertible paths are simply absent (the caller
+    reports them against the manifest).
+    """
+    out = dict(host)
+    for path in target_paths:
+        if path in out:
+            continue
+        shape, _dtype = want[path]
+        # unstack: stacked checkpoint leaf -> this loop-layout target
+        for stacked, idx, _m in _family_candidates(path):
+            src = out.get(stacked) if stacked in host else None
+            if (
+                src is not None
+                and src.ndim == len(shape) + 1
+                and src.shape[0] > idx
+                and tuple(src.shape[1:]) == tuple(shape)
+            ):
+                out[path] = np.ascontiguousarray(src[idx])
+                break
+        if path in out:
+            continue
+        # stack: loop-layout checkpoint leaves -> this stacked target
+        if not shape:
+            continue
+        n = shape[0]
+        members = _loop_members_for(host, path, n)
+        if members is not None and all(
+            tuple(host[p].shape) == tuple(shape[1:]) for p in members
+        ):
+            out[path] = np.stack([host[p] for p in members])
+    return out
+
+
+def _loop_members_for(host: dict, stacked_path: str, n: int) -> list | None:
+    """``name_0..name_{n-1}`` member paths in ``host`` for a stacked
+    target path, trying each ``['name']`` segment as the layer axis."""
+    for m in re.finditer(r"\['([A-Za-z0-9_]+)'\]", stacked_path):
+        prefix, suffix = stacked_path[: m.start()], stacked_path[m.end():]
+        name = m.group(1)
+        members = [f"{prefix}['{name}_{i}']{suffix}" for i in range(n)]
+        if all(p in host for p in members):
+            return members
+    return None

@@ -44,6 +44,7 @@ from .outage import (
     RetryPolicy,
     classify,
     classify_exception,
+    external_termination,
 )
 
 __all__ = [
@@ -58,6 +59,7 @@ __all__ = [
     "build_fallback_record",
     "classify",
     "classify_exception",
+    "external_termination",
     "fault_point",
     "install_plan",
 ]

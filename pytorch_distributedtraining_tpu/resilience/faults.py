@@ -23,6 +23,9 @@ Named sites (each threaded into the layer that owns it):
                        (``data/prefetch.py``)
 ``checkpoint.write``   transient I/O error on a checkpoint write
                        (``checkpoint_sharded.py``)
+``ckpt.write``         kill/delay INSIDE the background checkpoint writer
+                       — manufactures torn (uncommitted) step dirs for
+                       crash-consistency drills (``checkpoint_sharded.py``)
 ``train.preempt``      mid-step SIGTERM preemption, delivered to self at a
                        chosen ``maybe_save`` call (``checkpoint_sharded.py``)
 ``bench.probe``        bench probe child dies with an outage signature —
@@ -80,6 +83,7 @@ SITES = frozenset({
     "loader.fetch",
     "loader.stage",
     "checkpoint.write",
+    "ckpt.write",
     "train.preempt",
     "bench.probe",
     "bench.child",

@@ -103,6 +103,19 @@ def classify(rc: int | None, tail: str = "") -> OutageClass:
     return OutageClass.DETERMINISTIC
 
 
+def external_termination(rc: int | None) -> bool:
+    """True when a rank's exit looks like the WORKER WAS TAKEN AWAY —
+    SIGKILL/SIGTERM (negative subprocess convention or the 128+N shell
+    convention) or a kill-on-timeout (rc None) — rather than the program
+    failing on its own. This is the elastic launcher's shrink-vs-retry
+    discriminator: a preempted/OOM-killed/timed-out rank is *gone*, so
+    the surviving world relaunches smaller (shrink-to-survive); any other
+    outage-class failure (rendezvous flake, transient I/O) retries at the
+    same world size first.
+    """
+    return rc is None or rc in (-9, -15, 124, 137, 143)
+
+
 def classify_exception(exc: BaseException) -> OutageClass:
     """:func:`classify` for in-process exceptions (rendezvous, W&B, I/O).
 

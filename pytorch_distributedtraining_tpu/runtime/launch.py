@@ -28,7 +28,12 @@ import subprocess
 import sys
 
 from ..resilience.faults import active_plan
-from ..resilience.outage import OutageClass, RetryPolicy, classify
+from ..resilience.outage import (
+    OutageClass,
+    RetryPolicy,
+    classify,
+    external_termination,
+)
 from .dist import find_free_port
 
 
@@ -123,15 +128,25 @@ def spawn(
     return None
 
 
-def _run_world(opt, attempt: int) -> int:
-    """Launch one generation of the world; 0 on success.
+def _run_world(
+    opt, attempt: int, world: int | None = None,
+    extra_env: dict | None = None,
+) -> tuple[int, int]:
+    """Launch one generation of the world; returns ``(code, n_failed)``.
+
+    ``code`` is 0 on success, else the first failing rank's rc.
+    ``n_failed`` counts ranks that died on their OWN (crash, preemption,
+    chaos kill) — ranks the monitor itself terminated for fate-sharing
+    are victims, not failures, and the elastic shrink math
+    (``surviving world = world - n_failed``) must not count them.
 
     A crashed rank strands the others in the rendezvous/collective, so the
     monitor polls all children, kills the survivors on the first non-zero
     exit, and reports — the fate-sharing ``torch.distributed.launch``
     provides.
     """
-    world = opt.nnodes * opt.nproc_per_node
+    nproc = world if world is not None else opt.nproc_per_node
+    world = opt.nnodes * nproc
     # fresh port per generation: the previous coordinator socket may
     # linger in TIME_WAIT after a crash — honor a pinned --master_port
     # only for the first generation, else every retry would try to bind
@@ -142,8 +157,8 @@ def _run_world(opt, attempt: int) -> int:
         else find_free_port()
     )
     procs = []
-    for local_rank in range(opt.nproc_per_node):
-        rank = opt.node_rank * opt.nproc_per_node + local_rank
+    for local_rank in range(nproc):
+        rank = opt.node_rank * nproc + local_rank
         env = _child_env(
             rank, local_rank, world, opt.master_addr, port,
             opt.one_cpu_device_per_rank,
@@ -151,6 +166,7 @@ def _run_world(opt, attempt: int) -> int:
         # scripts can adapt (e.g. resume from the preemption checkpoint,
         # cf. --start-epoch "useful on restarts", Stoke-DDP.py:161)
         env["GRAFT_RESTART_ATTEMPT"] = str(attempt)
+        env.update(extra_env or {})
         procs.append(
             subprocess.Popen(
                 [sys.executable, opt.script, *opt.script_args], env=env
@@ -173,9 +189,12 @@ def _run_world(opt, attempt: int) -> int:
     chaos_fired: set[int] = set()
     all_procs = list(procs)  # stable local_rank -> proc indexing
     t_start = _time.monotonic()
+    escalate_s = float(os.environ.get("GRAFT_LAUNCH_ESCALATE_S", "15"))
 
     code = 0
+    n_failed = 0
     failed_at = None
+    signalled: set[int] = set()  # pids the MONITOR terminated (fate-sharing)
     try:
         while procs:
             for i, rule in enumerate(chaos):
@@ -185,6 +204,8 @@ def _run_world(opt, attempt: int) -> int:
                     chaos_fired.add(i)
                     victim = all_procs[(rule.rank or 0) % len(all_procs)]
                     if victim.poll() is None:
+                        # a chaos kill IS a preemption: the victim counts
+                        # as failed, unlike a monitor fate-sharing kill
                         victim.kill()
             for p in list(procs):
                 rc = p.poll()
@@ -192,23 +213,30 @@ def _run_world(opt, attempt: int) -> int:
                     continue
                 procs.remove(p)
                 if rc != 0:
+                    if p.pid not in signalled:
+                        n_failed += 1
                     code = code or rc
                     failed_at = failed_at or _time.monotonic()
                     for q in procs:
+                        signalled.add(q.pid)
                         q.terminate()
             # escalate: a survivor trapping SIGTERM (e.g. writing its
             # preemption checkpoint while stuck in the dead collective)
             # must not stall the monitor forever
-            if failed_at is not None and _time.monotonic() - failed_at > 15.0:
+            if (
+                failed_at is not None
+                and _time.monotonic() - failed_at > escalate_s
+            ):
                 for q in procs:
                     if q.poll() is None:
+                        signalled.add(q.pid)
                         q.kill()
             _time.sleep(0.1)
     finally:
         for q in procs:
             if q.poll() is None:
                 q.kill()
-    return code
+    return code, n_failed
 
 
 def _report_flight_records(run_dir: str) -> None:
@@ -274,6 +302,20 @@ def main(argv=None) -> int:
         "N times; children see GRAFT_RESTART_ATTEMPT and should resume "
         "from their last checkpoint (cf. --start-epoch, Stoke-DDP.py:161)",
     )
+    parser.add_argument(
+        "--elastic", action="store_true",
+        help="shrink-to-survive: when a generation dies to an EXTERNAL "
+        "termination (preemption/OOM-kill/timeout — resilience.outage."
+        "external_termination), relaunch with the surviving world size "
+        "instead of the original one; children see the decision as "
+        "GRAFT_RECOVERY_MODE=shrink|retry and must reshard their resume "
+        "checkpoint onto the smaller mesh",
+    )
+    parser.add_argument(
+        "--min_world", "--min-world", type=int, default=1, dest="min_world",
+        help="floor for --elastic shrinking: never relaunch fewer than "
+        "this many ranks (default 1)",
+    )
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     opt = parser.parse_args(argv)
@@ -294,6 +336,17 @@ def main(argv=None) -> int:
             "--max_restarts requires single-node (--nnodes=1); multi-node "
             "elastic recovery needs an external coordinator"
         )
+    if opt.elastic:
+        if opt.nnodes > 1:
+            parser.error("--elastic requires single-node (--nnodes=1)")
+        if opt.max_restarts < 1:
+            parser.error("--elastic needs --max_restarts >= 1 (shrinking "
+                         "only happens across a relaunch)")
+        if not (1 <= opt.min_world <= opt.nproc_per_node):
+            parser.error(
+                f"--min_world must be in [1, nproc_per_node="
+                f"{opt.nproc_per_node}], got {opt.min_world}"
+            )
 
     # one policy drives the inter-generation backoff; the shared classifier
     # decides whether another generation can even help (a usage error or
@@ -310,8 +363,11 @@ def main(argv=None) -> int:
     run_dir = os.environ.get(
         "GRAFT_RUN_DIR", f"/tmp/graft-runs/launch-{os.getpid()}"
     )
+    world = opt.nproc_per_node
+    mode: str | None = None
     for attempt in range(opt.max_restarts + 1):
-        code = _run_world(opt, attempt)
+        extra = {"GRAFT_RECOVERY_MODE": mode} if mode else None
+        code, n_failed = _run_world(opt, attempt, world=world, extra_env=extra)
         if code == 0:
             return 0
         _report_flight_records(run_dir)
@@ -325,6 +381,23 @@ def main(argv=None) -> int:
                     flush=True,
                 )
                 return code
+            if opt.elastic and external_termination(code):
+                # ranks were TAKEN (preempted/killed/timed out): the next
+                # generation runs with whoever survived, floored at
+                # --min_world — shrink-to-survive instead of giving up
+                new_world = max(opt.min_world, world - max(1, n_failed))
+                mode = "shrink" if new_world < world else "retry"
+                if mode == "shrink":
+                    print(
+                        f"[launch] elastic: shrinking world "
+                        f"{world} -> {new_world} (rc={code}, "
+                        f"{n_failed} rank(s) lost)",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                world = new_world
+            else:
+                mode = "retry"
             delay = next(delays, 0.0)
             print(
                 f"[launch] world failed (rc={code}, class={cls.value}), "

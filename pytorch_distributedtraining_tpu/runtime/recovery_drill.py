@@ -1,0 +1,190 @@
+"""Elastic recovery drill: the script the bench recovery arm launches.
+
+Run under ``runtime/launch.py --elastic`` with a :class:`FaultPlan` that
+tears a checkpoint write and then preempts rank 0, this script exercises
+the whole recovery path end to end: async checkpointing with commit
+markers, the launcher's shrink-to-survive decision, and an N→M resharded
+resume on the surviving (smaller) world — then reports every step as a
+JSONL event stream the bench parent turns into ``time_to_recover_s``.
+
+Topology note: this image's CPU backend refuses cross-process collectives,
+so the drill deliberately runs its jax world LOCAL to rank 0 — rank 0
+trains a tiny ZeRO-2 model on a virtual-device mesh sized from
+``WORLD_SIZE`` (``fsdp = min(4, 2 * world)``), while every other rank is a
+passive stdlib worker standing in for a machine that can be preempted.
+Shrinking the launcher world 2 → 1 therefore halves the mesh (fsdp 4 → 2)
+and the resume genuinely reshards params AND optimizer moments.
+
+Env contract (all inherited through the launcher):
+
+- ``RANK`` / ``WORLD_SIZE`` / ``GRAFT_RESTART_ATTEMPT`` — launcher contract.
+- ``GRAFT_RECOVERY_MODE`` — launcher's shrink/retry decision (gen > 0).
+- ``GRAFT_DRILL_OUT``   — JSONL event file (appended across generations).
+- ``GRAFT_DRILL_CKPT``  — checkpoint root shared across generations.
+- ``GRAFT_DRILL_STEPS`` — total train steps to reach (default 6).
+- ``GRAFT_FAULT_PLAN``  — the chaos schedule (``ckpt.write`` tear +
+  ``train.preempt`` kill), consumed inside the checkpoint layer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+
+def _emit(path: str, **event) -> None:
+    """Append one JSONL event; O_APPEND keeps generations from clobbering."""
+    event.setdefault("t", time.time())
+    line = json.dumps(event) + "\n"
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, line.encode())
+    finally:
+        os.close(fd)
+
+
+def _worker_main(done_marker: str) -> int:
+    """Passive non-zero rank: a preemptible machine, not a jax process.
+
+    Exits 0 once rank 0 writes the done marker; a monitor SIGTERM (fate
+    sharing after rank 0 dies) terminates it with the default -15, which
+    the launcher's n_failed accounting correctly ignores.
+    """
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    while not os.path.exists(done_marker):
+        time.sleep(0.2)
+    return 0
+
+
+def _trainer_main(out: str, ckpt_root: str, done_marker: str) -> int:
+    world = int(os.environ.get("WORLD_SIZE", "1"))
+    attempt = int(os.environ.get("GRAFT_RESTART_ATTEMPT", "0"))
+    mode = os.environ.get("GRAFT_RECOVERY_MODE", "")
+    total_steps = int(os.environ.get("GRAFT_DRILL_STEPS", "6"))
+
+    # local virtual-device mesh BEFORE importing jax; never touch
+    # jax.distributed — cross-process CPU collectives don't exist here
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4"
+        ).strip()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributedtraining_tpu import optim
+    from pytorch_distributedtraining_tpu.checkpoint_sharded import (
+        CheckpointManager,
+    )
+    from pytorch_distributedtraining_tpu.models import Net
+    from pytorch_distributedtraining_tpu.parallel import (
+        TrainStep,
+        ZeRO2,
+        create_train_state,
+    )
+    from pytorch_distributedtraining_tpu.runtime.mesh import (
+        MeshSpec,
+        make_mesh,
+    )
+
+    fsdp = min(4, 2 * world)
+    mesh = make_mesh(MeshSpec.zero(fsdp), devices=jax.devices()[:fsdp])
+    model = Net(upscale_factor=2)
+    tx = optim.adamw(lr=1e-3, clip_grad_norm=1.0)
+    policy = ZeRO2(min_shard_size=1)
+
+    def loss_fn(params, batch, rng, ms):
+        lr_img, hr = batch
+        out_img = model.apply({"params": params}, lr_img)
+        return jnp.mean((out_img - hr) ** 2), {}
+
+    state, sh = create_train_state(
+        init_fn=lambda r: (
+            model.init(r, jnp.zeros((1, 8, 8, 3)))["params"], {},
+        ),
+        tx=tx, mesh=mesh, policy=policy,
+    )
+    step_fn = TrainStep(
+        loss_fn, tx, mesh, policy, state_shardings=sh, donate=False
+    )
+    rng = np.random.default_rng(0)
+    hr = rng.random((8, 16, 16, 3)).astype(np.float32)
+    lo = hr.reshape(8, 8, 2, 8, 2, 3).mean(axis=(2, 4))
+
+    mgr = CheckpointManager(
+        ckpt_root, save_every=1, keep=10,
+        handle_sigterm=True, async_save=True,
+    )
+    start = 0
+    if attempt > 0:
+        torn = sorted(
+            d for d in os.listdir(ckpt_root) if d.endswith(".tmp")
+        ) if os.path.isdir(ckpt_root) else []
+        resumed = mgr.restore_latest(jax.tree.map(lambda x: x, state))
+        if resumed is None:
+            _emit(out, event="error", attempt=attempt,
+                  detail="no committed checkpoint to resume from")
+            return 1
+        start, state = resumed
+        _emit(
+            out, event="resume", step=start, attempt=attempt, world=world,
+            fsdp=fsdp, mode=mode, torn_dirs=torn,
+        )
+
+    try:
+        s = state
+        with mesh:
+            for _ in range(start, total_steps):
+                s, _ = step_fn(s, (lo, hr))
+                # train.preempt (kill) and ckpt.write (tear) both fire in
+                # here, per the installed GRAFT_FAULT_PLAN
+                mgr.maybe_save(int(s.step), s)
+                _emit(
+                    out, event="step", step=int(s.step), attempt=attempt,
+                    world=world, fsdp=fsdp,
+                )
+        mgr.wait()
+    finally:
+        mgr.close()
+
+    _emit(
+        out, event="done", step=total_steps, attempt=attempt, world=world,
+        committed=mgr.all_steps(),
+    )
+    with open(done_marker, "w") as fh:
+        fh.write("done\n")
+    return 0
+
+
+def main() -> int:
+    out = os.environ.get("GRAFT_DRILL_OUT")
+    ckpt_root = os.environ.get("GRAFT_DRILL_CKPT")
+    if not out or not ckpt_root:
+        print(
+            "recovery_drill: GRAFT_DRILL_OUT and GRAFT_DRILL_CKPT required",
+            file=sys.stderr,
+        )
+        return 2
+    done_marker = os.path.join(ckpt_root, "_DRILL_DONE")
+    rank = int(os.environ.get("RANK", "0"))
+    if rank != 0:
+        return _worker_main(done_marker)
+    os.makedirs(ckpt_root, exist_ok=True)
+    return _trainer_main(out, ckpt_root, done_marker)
+
+
+if __name__ == "__main__":
+    # the launcher runs this file as a plain script (no -m), so the repo
+    # root is not on sys.path — add it before the package imports happen
+    _root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    if _root not in sys.path:
+        sys.path.insert(0, _root)
+    sys.exit(main())

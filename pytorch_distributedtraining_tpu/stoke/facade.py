@@ -1631,6 +1631,32 @@ class Stoke:
                 f"restored sharded checkpoint @ step {int(self._state.step)}"
             )
 
+    def save_portable(self, path: str, *, step: int | None = None) -> str:
+        """Topology-independent save of the FULL train state: the portable
+        format (manifest + per-rank shards + commit marker) that
+        :meth:`load_resharded` can restore onto a DIFFERENT mesh shape."""
+        self._require_state()
+        from ..checkpoint_sharded import save_portable as _save
+
+        return _save(
+            path, self._state,
+            step=int(self._state.step) if step is None else step,
+        )
+
+    def load_resharded(self, path: str) -> None:
+        """Restore a :meth:`save_portable` checkpoint into the live state,
+        re-homing every leaf (params AND optimizer moments) onto THIS
+        run's mesh/shardings — the N→M elastic-resume path."""
+        self._require_state()
+        from ..checkpoint_sharded import restore_portable as _restore
+
+        self._state = _restore(path, self._state)
+        if self.verbose:
+            self.print_on_devices(
+                f"resharded portable checkpoint @ step "
+                f"{int(self._state.step)}"
+            )
+
     def export_trace(self, path: str | None = None) -> str | None:
         """Write recorded telemetry spans as Chrome trace-event JSON.
 
