@@ -199,6 +199,7 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
     # mesh shape (or world size) restores onto THIS mesh via the portable
     # manifest (checkpoint_sharded.restore_latest → reshard path)
     mgr = None
+    start_step = 0
     if getattr(opt, "ckpt", None):
         from pytorch_distributedtraining_tpu.checkpoint_sharded import (
             CheckpointManager,
@@ -217,10 +218,23 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
             print(f"===> Resumed from checkpoint @ step {start_step}"
                   + (f" (recovery_mode={mode})" if mode else ""))
 
+    # a resume COMPLETES the original --epochs schedule: epochs and
+    # iterations the checkpoint already covers are skipped, not re-trained
+    # (one optimizer step per iteration, so step count maps onto the
+    # epoch/iteration grid directly)
+    steps_per_epoch = len(training_dataloader)
+    start_epoch = start_step // steps_per_epoch if steps_per_epoch else 0
+    skip_iters = start_step % steps_per_epoch if steps_per_epoch else 0
+    if start_epoch >= epochs:
+        print(f"===> Checkpoint step {start_step} already covers the "
+              f"{epochs}-epoch schedule; nothing left to train")
+
     loss = None
     try:
-        for e in range(epochs):
+        for e in range(start_epoch, epochs):
             for iteration, batch in enumerate(training_dataloader, 1):
+                if e == start_epoch and iteration <= skip_iters:
+                    continue
                 state, metrics = step(state, batch)
                 loss = metrics["loss"]
                 if mgr is not None:

@@ -66,6 +66,11 @@ def ckpt_commits_silent(ctx):
     stats = _ckpt_stats()
     if stats is None or stats.get("save_every") is None:
         return
+    # only process 0 runs the portable commit, so on ranks > 0
+    # commits_observed is structurally 0 in a perfectly healthy run —
+    # evaluate the rule where the commit actually happens
+    if stats.get("process_index") not in (None, 0):
+        return
     if stats.get("saves_initiated", 0) > 0 and not stats.get(
         "commits_observed", 0
     ):

@@ -43,6 +43,7 @@ import shutil
 import signal
 import threading
 import time
+import uuid
 from typing import Any
 
 import jax
@@ -71,6 +72,10 @@ runtime_stats: dict = {
     "last_snapshot_s": None,
     "last_write_error": None,
     "manifest_mismatches": [],
+    # which process these counters describe: only rank 0 runs the commit,
+    # so commits_observed is structurally 0 on ranks > 0 (the analyzer's
+    # ckpt-commits-silent rule must not read that as a dead writer)
+    "process_index": None,
 }
 
 
@@ -244,12 +249,66 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
-def _write_rank_shards(tmp_dir: str, snap: _HostSnapshot, rank: int) -> None:
+def _agree_nonce() -> str:
+    """One write-attempt stamp every process agrees on (rank 0's uuid).
+
+    The broadcast is a collective, so call it from the main thread at a
+    point all processes reach in the same order (CheckpointManager.save
+    qualifies: scheduled saves are step-deterministic and preemption
+    saves are agreed first). It doubles as the barrier that keeps other
+    ranks' writers out of a staging dir rank 0 is about to clear — they
+    write only after seeing a manifest carrying THIS nonce.
+    """
+    local = uuid.uuid4().hex
+    if jax.process_count() == 1:
+        return local
+    from jax.experimental import multihost_utils
+
+    arr = np.frombuffer(bytes.fromhex(local), dtype=np.uint8)
+    out = multihost_utils.broadcast_one_to_all(arr)
+    return bytes(bytearray(np.asarray(out))).hex()
+
+
+def _commit_deadline() -> float:
+    return time.monotonic() + float(
+        os.environ.get("GRAFT_CKPT_COMMIT_TIMEOUT", "120")
+    )
+
+
+def _wait_manifest_nonce(tmp_dir: str, expect: "str | None") -> str:
+    """Non-zero ranks: block until rank 0's manifest for THIS attempt is
+    visible, and return its nonce. A manifest left by a crashed previous
+    attempt carries a different nonce and is waited out — that is what
+    keeps this rank's payload from landing in (and being deleted with)
+    a staging dir rank 0 is about to clear."""
+    deadline = _commit_deadline()
+    man = os.path.join(tmp_dir, MANIFEST_NAME)
+    while True:
+        try:
+            with open(man, encoding="utf-8") as fh:
+                nonce = json.load(fh).get("nonce")
+            if nonce is not None and (expect is None or nonce == expect):
+                return nonce
+        except (OSError, ValueError):
+            pass  # not there yet, or mid-write
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"checkpoint write: no manifest for attempt "
+                f"{expect or '<any>'} appeared in {tmp_dir}"
+            )
+        time.sleep(0.05)
+
+
+def _write_rank_shards(
+    tmp_dir: str, snap: _HostSnapshot, rank: int, nonce: str,
+) -> None:
     """This process's shard payload + sidecar into the tmp dir.
 
-    The ``.json`` sidecar is written (and fsynced) AFTER the ``.npz`` —
-    its presence is the per-rank "my payload is durable" marker the
-    rank-0 committer waits for.
+    The ``.json`` sidecar is written (and fsynced) AFTER the ``.npz``,
+    then renamed into place — its atomic appearance is the per-rank "my
+    payload is durable" marker the rank-0 committer waits for. The nonce
+    scopes it to this write attempt: a sidecar left by a crashed earlier
+    attempt never satisfies the current commit.
     """
     arrays: dict = {}
     entries = []
@@ -262,33 +321,54 @@ def _write_rank_shards(tmp_dir: str, snap: _HostSnapshot, rank: int) -> None:
     np.savez(npz, **arrays)
     _fsync_file(npz)
     sidecar = os.path.join(tmp_dir, f"shards_r{rank}.json")
-    with open(sidecar, "w", encoding="utf-8") as fh:
-        json.dump({"rank": rank, "entries": entries}, fh)
+    with open(sidecar + ".part", "w", encoding="utf-8") as fh:
+        json.dump({"rank": rank, "nonce": nonce, "entries": entries}, fh)
         fh.flush()
         os.fsync(fh.fileno())
+    os.rename(sidecar + ".part", sidecar)
+
+
+def _ranks_present(tmp_dir: str, nonce: str) -> set:
+    """Ranks whose sidecar for THIS attempt has durably landed."""
+    have = set()
+    for sidecar in glob.glob(os.path.join(tmp_dir, "shards_r*.json")):
+        try:
+            with open(sidecar, encoding="utf-8") as fh:
+                meta = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if meta.get("nonce") == nonce:
+            have.add(int(meta.get("rank", -1)))
+    return have
 
 
 def _commit_portable(
     tmp_dir: str, final_dir: str, world_size: int, step: int | None,
+    nonce: str,
 ) -> None:
-    """Rank-0 commit: wait for every rank's sidecar, write the marker,
-    fsync, atomically rename ``<step>.tmp`` -> ``<step>``."""
-    deadline = time.monotonic() + float(
-        os.environ.get("GRAFT_CKPT_COMMIT_TIMEOUT", "120")
-    )
+    """Rank-0 commit: wait for every rank's CURRENT-attempt sidecar,
+    write the marker, fsync, atomically rename ``<step>.tmp`` ->
+    ``<step>``. Sidecars from a crashed earlier attempt (different
+    nonce) never count toward the rank tally."""
+    deadline = _commit_deadline()
     while True:
-        have = len(glob.glob(os.path.join(tmp_dir, "shards_r*.json")))
-        if have >= world_size:
+        have = _ranks_present(tmp_dir, nonce)
+        if have >= set(range(world_size)):
             break
         if time.monotonic() > deadline:
             raise TimeoutError(
-                f"checkpoint commit: only {have}/{world_size} rank payloads "
-                f"arrived in {tmp_dir} — leaving the dir torn (un-renamed)"
+                f"checkpoint commit: only {len(have)}/{world_size} rank "
+                f"payloads for attempt {nonce} arrived in {tmp_dir} — "
+                f"leaving the dir torn (un-renamed)"
             )
         time.sleep(0.05)
     marker = os.path.join(tmp_dir, COMMIT_MARKER)
     with open(marker, "w", encoding="utf-8") as fh:
-        json.dump({"step": step, "t": time.time(), "ranks": world_size}, fh)
+        json.dump(
+            {"step": step, "t": time.time(), "ranks": world_size,
+             "nonce": nonce},
+            fh,
+        )
         fh.flush()
         os.fsync(fh.fileno())
     _fsync_dir(tmp_dir)
@@ -299,41 +379,71 @@ def _commit_portable(
 
 
 def write_portable(
-    path: str, snap: _HostSnapshot, *, step: int | None = None,
+    path: str,
+    snap: _HostSnapshot,
+    *,
+    step: int | None = None,
+    nonce: "str | None" = None,
 ) -> str:
     """Serialize a host snapshot with the commit-marker protocol.
 
     Every process writes its own shard payload into ``<path>.tmp``;
-    process 0 writes the manifest, waits for all payloads, writes the
-    ``_COMMIT`` marker and renames. A kill anywhere in here leaves a
-    ``*.tmp`` dir :meth:`CheckpointManager.all_steps` never matches.
+    process 0 first clears any staging dir a crashed earlier attempt
+    left there (stale payloads must never satisfy this attempt's
+    commit), writes the manifest, waits for all payloads stamped with
+    this attempt's ``nonce``, writes the ``_COMMIT`` marker and renames.
+    A kill anywhere in here leaves a ``*.tmp`` dir
+    :meth:`CheckpointManager.all_steps` never matches.
+
+    ``nonce`` is the attempt stamp; pass the :func:`_agree_nonce` result
+    when calling from several processes (CheckpointManager.save does).
+    Without one, non-zero ranks adopt the nonce of whatever manifest
+    they see — safe (a mismatched attempt can only time out torn, never
+    commit stale data) but racy enough to cost a save in the rare
+    crash-then-immediately-rewrite corner.
     """
     path = _abs(path)
     tmp_dir = path + ".tmp"
     rank = jax.process_index()
     world = jax.process_count()
-    os.makedirs(tmp_dir, exist_ok=True)
+    runtime_stats["process_index"] = rank
+    if rank == 0:
+        if nonce is None:
+            nonce = uuid.uuid4().hex
+        if os.path.isdir(tmp_dir):
+            # stale staging dir from a crashed earlier attempt at this
+            # same step: clear it so none of its payloads survive into
+            # (or get merged out of) the dir this attempt commits
+            shutil.rmtree(tmp_dir)
+        os.makedirs(tmp_dir)
     # chaos site: kill/delay INSIDE the background writer — this is how
     # the chaos matrix manufactures torn checkpoint dirs
     fault_point("ckpt.write", path=path, step=step, rank=rank)
     if rank == 0:
+        manifest = snap.manifest(step)
+        manifest["nonce"] = nonce
         man = os.path.join(tmp_dir, MANIFEST_NAME)
         with open(man, "w", encoding="utf-8") as fh:
-            json.dump(snap.manifest(step), fh)
+            json.dump(manifest, fh)
             fh.flush()
             os.fsync(fh.fileno())
-    _write_rank_shards(tmp_dir, snap, rank)
+    else:
+        nonce = _wait_manifest_nonce(tmp_dir, nonce)
+    _write_rank_shards(tmp_dir, snap, rank, nonce)
     if rank == 0:
-        _commit_portable(tmp_dir, path, world, step)
+        _commit_portable(tmp_dir, path, world, step, nonce)
     return path
 
 
 def save_portable(path: str, state: Any, *, step: int | None = None) -> str:
-    """Synchronous snapshot + portable write (commit protocol included)."""
+    """Synchronous snapshot + portable write (commit protocol included).
+    In multi-process runs every process must call this (it agrees the
+    write-attempt nonce collectively)."""
     runtime_stats["saves_initiated"] += 1
+    nonce = _agree_nonce()
     snap = snapshot_to_host(state)
     with telemetry.span("checkpoint.write", "checkpoint", path=path):
-        return write_portable(path, snap, step=step)
+        return write_portable(path, snap, step=step, nonce=nonce)
 
 
 def is_portable_dir(path: str) -> bool:
@@ -357,14 +467,25 @@ def read_manifest(path: str) -> dict:
 
 def _assemble_host_tree(path: str) -> tuple[dict, dict]:
     """(manifest, {leaf path -> full global np.ndarray}) from a committed
-    portable dir — shard pieces from every rank placed by global index."""
+    portable dir — shard pieces from every rank placed by global index.
+
+    Only sidecars stamped with the manifest's write-attempt nonce (and a
+    rank inside the manifest's world) contribute: payloads a crashed
+    earlier attempt — possibly from a larger world — left behind are
+    ignored, not merged into the restored state."""
     path = _abs(path)
     manifest = read_manifest(path)
     leaves = manifest["leaves"]
+    nonce = manifest.get("nonce")
+    world = manifest.get("world_size")
     out: dict = {}
     for sidecar in sorted(glob.glob(os.path.join(path, "shards_r*.json"))):
         with open(sidecar, encoding="utf-8") as fh:
             meta = json.load(fh)
+        if nonce is not None and meta.get("nonce") != nonce:
+            continue  # stale attempt (legacy no-nonce manifests skip this)
+        if world is not None and not (0 <= int(meta.get("rank", -1)) < world):
+            continue  # rank from an old, larger world
         npz = np.load(sidecar[: -len(".json")] + ".npz")
         for entry in meta["entries"]:
             pstr = entry["leaf"]
@@ -388,29 +509,54 @@ def _record_mismatch(msg: str) -> None:
     runtime_stats["manifest_mismatches"].append(msg)
 
 
-def _target_sharding(leaf, target_mesh) -> NamedSharding | None:
+def _target_sharding(
+    leaf, target_mesh, pstr: str, global_shape: tuple,
+) -> NamedSharding | None:
     """The sharding to place a restored leaf onto: the template leaf's own
     NamedSharding re-homed onto ``target_mesh`` (shardings are metadata —
-    the same logical axes apply to any mesh shape that carries them)."""
+    the same logical axes apply to any mesh shape that carries them).
+
+    Spec axes the target mesh does not name are dropped (that dim
+    replicates there — e.g. a pp mesh restoring onto no-pp); axes it
+    does name must evenly divide the leaf's global dim, else this raises
+    a ValueError naming the leaf (recorded via :func:`_record_mismatch`
+    for the graftcheck runtime plane) instead of surfacing as an opaque
+    ``make_array_from_callback`` failure."""
     sharding = getattr(leaf, "sharding", None)
     if target_mesh is None:
         return sharding if isinstance(sharding, NamedSharding) else None
-    if isinstance(sharding, NamedSharding):
-        if sharding.mesh is target_mesh:
-            return sharding
-        spec = tuple(
-            entry if (
-                entry is None
-                or all(
-                    target_mesh.shape.get(a, 1) >= 1
-                    and a in target_mesh.axis_names
-                    for a in ((entry,) if isinstance(entry, str) else entry)
-                )
-            ) else None
-            for entry in tuple(sharding.spec)
+    if not isinstance(sharding, NamedSharding):
+        return NamedSharding(target_mesh, P())
+    if sharding.mesh is target_mesh:
+        return sharding
+    spec = []
+    problems = []
+    for d, entry in enumerate(tuple(sharding.spec)):
+        if entry is None:
+            spec.append(None)
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        if not all(a in target_mesh.axis_names for a in axes):
+            spec.append(None)
+            continue
+        width = 1
+        for a in axes:
+            width *= int(target_mesh.shape[a])
+        if d >= len(global_shape) or global_shape[d] % width != 0:
+            problems.append(
+                f"{pstr}: global shape {tuple(global_shape)} dim {d} is "
+                f"not divisible by target mesh axes {list(axes)} "
+                f"(size {width})"
+            )
+        spec.append(entry)
+    if problems:
+        for p in problems:
+            _record_mismatch(p)
+        raise ValueError(
+            "reshard_restore: template sharding cannot be re-homed onto "
+            "the target mesh: " + "; ".join(problems)
         )
-        return NamedSharding(target_mesh, P(*spec))
-    return NamedSharding(target_mesh, P())
+    return NamedSharding(target_mesh, P(*spec))
 
 
 def reshard_restore(path: str, target_mesh, template: Any) -> Any:
@@ -472,7 +618,7 @@ def reshard_restore(path: str, target_mesh, template: Any) -> Any:
     values = []
     for (p, leaf), pstr in zip(flat, target_paths):
         arr = host[pstr]
-        sharding = _target_sharding(leaf, target_mesh)
+        sharding = _target_sharding(leaf, target_mesh, pstr, tuple(arr.shape))
         if sharding is None:
             values.append(arr)
             continue
@@ -517,12 +663,12 @@ class _AsyncWriter:
             item = self._q.get()
             if item is None:
                 return
-            path, snap, step = item
+            path, snap, step, nonce = item
             try:
                 with telemetry.span(
                     "checkpoint.write.bg", "checkpoint", path=path
                 ):
-                    write_portable(path, snap, step=step)
+                    write_portable(path, snap, step=step, nonce=nonce)
             except BaseException as e:  # noqa: BLE001 - must not die silently
                 runtime_stats["last_write_error"] = f"{type(e).__name__}: {e}"
                 import sys as _sys
@@ -541,10 +687,12 @@ class _AsyncWriter:
     def in_flight(self) -> bool:
         return not self._idle.is_set()
 
-    def submit(self, path: str, snap: _HostSnapshot, step: int) -> None:
+    def submit(
+        self, path: str, snap: _HostSnapshot, step: int, nonce: str,
+    ) -> None:
         self.drain()
         self._idle.clear()
-        self._q.put((path, snap, step))
+        self._q.put((path, snap, step, nonce))
 
     def drain(self) -> None:
         self._idle.wait()
@@ -601,6 +749,7 @@ class CheckpointManager:
         )
         self._writer = _AsyncWriter() if async_save else None
         runtime_stats["save_every"] = self.save_every
+        runtime_stats["process_index"] = jax.process_index()
         os.makedirs(self.root, exist_ok=True)
         if handle_sigterm and threading.current_thread() is threading.main_thread():
             self._prev_handler = signal.signal(signal.SIGTERM, self._on_sigterm)
@@ -651,6 +800,12 @@ class CheckpointManager:
         # same chaos site as save_sharded: transient I/O at initiation
         fault_point("checkpoint.write", path=path)
         runtime_stats["saves_initiated"] += 1
+        runtime_stats["process_index"] = jax.process_index()
+        # attempt stamp agreed on the main thread (the broadcast is a
+        # collective; every process reaches save() at the same step) —
+        # the background writers then coordinate through the manifest
+        # nonce alone, with no collectives off the main thread
+        nonce = _agree_nonce()
         if self._writer is not None:
             # previous in-flight write finishes first (bounded host RAM),
             # and only COMPLETE checkpoints are GC'd before the new one
@@ -663,13 +818,14 @@ class CheckpointManager:
                 with telemetry.span(
                     "checkpoint.write", "checkpoint", path=path
                 ):
-                    write_portable(path, snap, step=step)
+                    write_portable(path, snap, step=step, nonce=nonce)
+                self._gc()
                 return path
-            self._writer.submit(path, snap, step)
+            self._writer.submit(path, snap, step, nonce)
             return path
         snap = snapshot_to_host(state)
         with telemetry.span("checkpoint.write", "checkpoint", path=path):
-            write_portable(path, snap, step=step)
+            write_portable(path, snap, step=step, nonce=nonce)
         self._gc()
         return path
 

@@ -210,6 +210,70 @@ class TestCrashConsistency:
         assert not os.path.isdir(str(root / "step_0000000001.tmp"))
         mgr.close()
 
+    def test_stale_staging_dir_never_pollutes_a_resave(
+        self, devices8, tmp_path
+    ):
+        """A crashed earlier attempt leaves ``step_N.tmp`` full of shard
+        payloads (possibly from a LARGER world). Re-saving the same step
+        must clear them: the stale sidecars must neither satisfy the
+        commit's rank count nor be merged into the restored state."""
+        mesh, state, step, batch = _make_state(devices8, MeshSpec.zero(8))
+        with mesh:
+            state, _ = step(state, batch)
+        root = tmp_path / "stale"
+        mgr = CheckpointManager(
+            str(root), save_every=1, keep=3, handle_sigterm=False,
+            async_save=True,
+        )
+        # craft the torn leftovers of a prior 2-process attempt at step 1:
+        # stale manifest (old nonce) + stale rank payloads, one of them
+        # from a rank the current world does not even have
+        torn = root / "step_0000000001.tmp"
+        torn.mkdir(parents=True)
+        (torn / "manifest.json").write_text(json.dumps(
+            {"format": "graft-portable-ckpt", "version": 1, "step": 1,
+             "world_size": 2, "nonce": "deadbeef" * 4, "leaves": {}}
+        ))
+        for r in (0, 1):
+            np.savez(str(torn / f"shards_r{r}.npz"),
+                     L0_S0=np.full((4,), 123.0, np.float32))
+            (torn / f"shards_r{r}.json").write_text(json.dumps(
+                {"rank": r, "nonce": "deadbeef" * 4, "entries": [
+                    {"key": "L0_S0", "leaf": "['bogus']",
+                     "index": [[0, 4]]},
+                ]}
+            ))
+        try:
+            mgr.save(1, state)
+            mgr.wait()
+            assert mgr.all_steps() == [1]
+            committed = root / "step_0000000001"
+            # the stale generation is gone, not renamed into the commit
+            assert not (committed / "shards_r1.json").exists()
+            man = json.loads((committed / "manifest.json").read_text())
+            assert man["nonce"] != "deadbeef" * 4
+            assert "['bogus']" not in man["leaves"]
+            resumed = mgr.restore_latest(jax.tree.map(lambda x: x, state))
+            assert resumed is not None and resumed[0] == 1
+            _assert_trees_equal(resumed[1].params, state.params)
+        finally:
+            mgr.close()
+
+    def test_over_budget_sync_fallback_still_gcs(self, devices8, tmp_path):
+        """host_budget=0 forces every async save down the synchronous
+        fallback; keep-last-k must still be enforced on that path."""
+        mesh, state, step, batch = _make_state(devices8, MeshSpec.zero(8))
+        mgr = CheckpointManager(
+            str(tmp_path / "budget"), save_every=1, keep=1,
+            handle_sigterm=False, async_save=True, host_budget_mb=0,
+        )
+        try:
+            for s in (1, 2, 3):
+                mgr.save(s, state)
+            assert mgr.all_steps() == [3]
+        finally:
+            mgr.close()
+
     def test_markerless_dir_never_resume_source(self, devices8, tmp_path):
         """A portable dir with a manifest but no _COMMIT (kill between
         manifest write and commit) is not a checkpoint."""
@@ -344,6 +408,25 @@ class TestReshardRestore:
             np.asarray(restacked["mu"]["h"]), want * 0.5
         )
 
+    def test_indivisible_rehome_raises_named_leaf(self, devices8, tmp_path):
+        """Re-homing a spec axis whose target mesh size does not divide
+        the leaf's global dim is a clear, named-leaf reshard error (and
+        recorded for graftcheck), not an opaque placement failure."""
+        mesh2 = make_mesh(MeshSpec(fsdp=2), devices=devices8[:2])
+        arr = jax.device_put(
+            np.arange(6, dtype=np.float32), NamedSharding(mesh2, P("fsdp"))
+        )
+        path = save_portable(str(tmp_path / "indiv"), {"w": arr}, step=0)
+        mesh4 = make_mesh(MeshSpec(fsdp=4), devices=devices8[:4])
+        runtime_stats["manifest_mismatches"].clear()
+        template = {"w": jax.ShapeDtypeStruct(
+            (6,), np.float32, sharding=NamedSharding(mesh2, P("fsdp"))
+        )}
+        with pytest.raises(ValueError, match=r"\['w'\].*not divisible"):
+            reshard_restore(path, mesh4, template)
+        assert runtime_stats["manifest_mismatches"]
+        runtime_stats["manifest_mismatches"].clear()
+
     def test_manifest_mismatch_raises_and_is_recorded(
         self, devices8, tmp_path
     ):
@@ -441,6 +524,27 @@ class TestGraftcheckRules:
             runtime_stats["commits_observed"] = 1
             report = self._run()
             assert "ckpt-commits-silent" not in [
+                f.rule for f in report.findings
+            ]
+        finally:
+            runtime_stats.update(saved)
+
+    def test_commits_silent_only_fires_on_rank_zero(self):
+        """Only rank 0 runs the commit, so commits_observed==0 on a
+        non-zero rank is the healthy steady state, not a dead writer."""
+        saved = dict(runtime_stats)
+        try:
+            runtime_stats.update(
+                save_every=100, saves_initiated=3, commits_observed=0,
+                process_index=1,
+            )
+            report = self._run()
+            assert "ckpt-commits-silent" not in [
+                f.rule for f in report.findings
+            ]
+            runtime_stats["process_index"] = 0
+            report = self._run()
+            assert "ckpt-commits-silent" in [
                 f.rule for f in report.findings
             ]
         finally:
