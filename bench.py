@@ -343,7 +343,7 @@ _ARM_ENVS = (  # envs that change WHICH arm is being measured
     "GRAFT_BENCH_NORM", "GRAFT_BENCH_SOFTMAX", "GRAFT_BENCH_LOOP",
     "GRAFT_BENCH_SCAN_K", "GRAFT_BENCH_FEED", "GRAFT_BENCH_PREFETCH",
     "GRAFT_REMAT", "GRAFT_SCAN_LAYERS", "GRAFT_WIRE", "GRAFT_FP8",
-    "GRAFT_BENCH_RECOVERY",
+    "GRAFT_BENCH_RECOVERY", "GRAFT_BENCH_SERVE",
 )
 
 
@@ -603,6 +603,58 @@ def _recovery_arm() -> None:
     _emit_result(json.dumps(record))
 
 
+def _serve_arm() -> None:
+    """Serving arm (GRAFT_BENCH_SERVE=1): the latency-SLO record.
+
+    Runs ``benchmarks/serve_bench.py`` in a child: continuous vs static
+    batching over the same seeded open-loop trace, p50/p99 latency and
+    TTFT, throughput, batch occupancy, the zero-steady-recompile
+    assertion, and the in-process graftcheck verdict. Defaults to the
+    pool-free CPU self-test (``GRAFT_BENCH_PLATFORM=cpu``) unless the
+    caller pins a platform.
+    """
+    env = dict(os.environ)
+    env.setdefault("GRAFT_BENCH_PLATFORM", "cpu")
+    if env["GRAFT_BENCH_PLATFORM"] == "cpu":
+        env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONUNBUFFERED"] = "1"
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks", "serve_bench.py",
+    )
+    _status("serve arm: continuous vs static batching SLO bench")
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], env=env, capture_output=True,
+            text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(script)),
+        )
+    except subprocess.TimeoutExpired:
+        _emit_error("serve arm: serve_bench.py hung >600s")
+        return
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "")[-500:]
+        _emit_error(f"serve arm: rc={proc.returncode}: {tail}")
+        return
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("metric") == "serve_slo":
+                # the harvest schema wants a scalar value alongside the
+                # full record: headline = continuous-arm throughput
+                rec.setdefault(
+                    "value", rec["continuous"]["throughput_tok_s"]
+                )
+                rec.setdefault("unit", "tokens/sec")
+                _emit_result(json.dumps(rec))
+                return
+    _emit_error("serve arm: no serve_slo record in child output")
+
+
 def _extract_json_line(lines: list[str]) -> str | None:
     """Last line that parses as the result record, if any."""
     for line in reversed(lines):
@@ -631,6 +683,11 @@ def main() -> None:
         # the recovery arm is pool-free (CPU drill through the elastic
         # launcher) — no probe loop, no TPU claim, its own 900s bound
         _recovery_arm()
+        return
+    if os.environ.get("GRAFT_BENCH_SERVE"):
+        # the serving arm defaults to the pool-free CPU self-test; its
+        # child owns warmup/steady bookkeeping and the graftcheck verdict
+        _serve_arm()
         return
 
     # Hard guarantees: the alarm fires at the self-deadline; SIGTERM from a

@@ -9,6 +9,17 @@ ladder's transformer), batch 8, 128-token prompt, 128 new tokens, bf16.
 One JSON line per arm:
     {"metric": "gpt2_decode_tokens_per_sec", ...}   (greedy)
     {"metric": "gpt2_decode_topp_tokens_per_sec", ...}  (top-p 0.9)
+    {"metric": "gpt2_prefill_tokens_per_sec", ...}  (prefill phase alone)
+    {"metric": "gpt2_decode_only_tokens_per_sec", ...}  (decode phase alone)
+
+The fused metrics above time prompt+generation as one program — the right
+number for batch jobs, but it hides that prefill and decode sit on
+opposite roofline walls (prefill is a compute-bound matmul over the whole
+prompt; decode re-reads every weight per token, bandwidth-bound). The
+phase-split arms time them separately: prefill tokens/s doubles as TTFT
+(time to first token — prefill samples it), decode-only tokens/s is the
+steady per-token rate a serving SLO actually pays (serve_bench.py's p99
+decomposes against these two).
 
 Env: GRAFT_BENCH_PLATFORM=cpu -> tiny model CPU self-test;
 GRAFT_DECODE_BATCH / GRAFT_DECODE_PROMPT / GRAFT_DECODE_NEW resize.
@@ -125,6 +136,88 @@ def main() -> None:
             "ms_per_token": round(dt / NEW * 1e3, 3),
             "roofline_tok_s": round(roofline_tok_s, 1),
         }), flush=True)
+
+    # -- phase split: prefill alone (TTFT) and decode alone ----------------
+    from pytorch_distributedtraining_tpu.models.generate import (
+        init_cache, sample_logits,
+    )
+
+    @jax.jit
+    def prefill(params, prompt):
+        cache = init_cache(model, BATCH, PROMPT + NEW)
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, prompt, mutable=["cache"]
+        )
+        tok = sample_logits(
+            logits[:, -1], jax.random.PRNGKey(1), temperature=0.0
+        )
+        return mutated["cache"], tok
+
+    @jax.jit
+    def decode_only(params, cache, tok):
+        def step(carry, step_rng):
+            cache, tok = carry
+            logits, mutated = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                mutable=["cache"],
+            )
+            nxt = sample_logits(logits[:, -1], step_rng, temperature=0.0)
+            return (mutated["cache"], nxt), tok
+
+        keys = jax.random.split(jax.random.PRNGKey(2), NEW - 1)
+        (_, last), _ = jax.lax.scan(step, (cache, tok), keys)
+        return last
+
+    cache, tok = prefill(params, prompt)  # compile + warm both phases
+    jax.block_until_ready(decode_only(params, cache, tok))
+
+    # prefill: chain rep i's prompt on rep i-1's sampled token (same
+    # anti-memoization discipline as the fused arms)
+    carry = jnp.int32(0)
+    t0 = time.perf_counter()
+    for i in range(REPS):
+        cache, tok = prefill(params, (prompts[i] + carry) % cfg.vocab_size)
+        carry = tok.max().astype(jnp.int32)
+    int(carry)
+    dt_prefill = (time.perf_counter() - t0) / REPS
+    # prefill is compute-bound: ~2 * n_params flops per prompt token
+    prefill_roof = 4e14 / (2.0 * n_params)
+    prefill_tok_s = BATCH * PROMPT / dt_prefill
+    guard(
+        "gpt2_prefill_tokens_per_sec", prefill_tok_s, "tokens/sec",
+        prefill_roof,
+        f"400 TFLOP/s peak / {2 * n_params / 1e6:.0f} MFLOP per token",
+    )
+    print(json.dumps({
+        "metric": "gpt2_prefill_tokens_per_sec",
+        "value": round(prefill_tok_s, 1),
+        "unit": "tokens/sec",
+        "ttft_ms": round(dt_prefill * 1e3, 3),
+        "prompt_tokens": BATCH * PROMPT,
+    }), flush=True)
+
+    # decode-only: NEW-1 scan steps (the prefill already sampled token #1);
+    # chain on the previous rep's last token
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        tok = decode_only(params, cache, tok)
+    int(tok.max())
+    dt_decode = (time.perf_counter() - t0) / REPS
+    decode_tok_s = BATCH * (NEW - 1) / dt_decode
+    guard(
+        "gpt2_decode_only_tokens_per_sec", decode_tok_s, "tokens/sec",
+        roofline_tok_s,
+        f"batch {BATCH} x 2 TB/s HBM / {weight_bytes / 1e6:.0f} MB "
+        f"weights read per step",
+    )
+    print(json.dumps({
+        "metric": "gpt2_decode_only_tokens_per_sec",
+        "value": round(decode_tok_s, 1),
+        "unit": "tokens/sec",
+        "ms_per_token": round(dt_decode / (NEW - 1) * 1e3, 3),
+        "ttft_ms": round(dt_prefill * 1e3, 3),
+        "roofline_tok_s": round(roofline_tok_s, 1),
+    }), flush=True)
 
 
 if __name__ == "__main__":

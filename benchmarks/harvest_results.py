@@ -74,6 +74,7 @@ STAGES = [
     ("bench_b36", "bench.py, batch 36 (occupancy probe)"),
     ("bench_trace", "bench.py with op-trace capture"),
     ("decode", "GPT-2 decode throughput (decode_bench.py)"),
+    ("serve", "continuous-batching serving engine SLO bench (serve_bench.py)"),
     ("ladder", "five-config ladder (ladder.py --all)"),
 ]
 
@@ -99,6 +100,8 @@ ARM_KNOBS = {
     "bench_wire_fp8": "GRAFT_WIRE=fp8_e4m3",
     # pool-free robustness arm (unit "s", never an A/B throughput winner)
     "recovery": "GRAFT_BENCH_RECOVERY=1",
+    # serving SLO arm (summary record; continuous-vs-static lives inside)
+    "serve": "GRAFT_BENCH_SERVE=1",
 }
 
 

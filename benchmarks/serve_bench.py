@@ -1,0 +1,250 @@
+"""Latency-SLO serving bench: continuous vs static batching, same trace.
+
+Open-loop load generator (seeded Poisson arrivals, mixed prompt lengths
+and token budgets) driven through the ``serve/`` engine twice:
+
+- **continuous** — the engine under test: paged KV cache, chunked prefill
+  interleaved with batched decode, requests admitted the tick a slot
+  frees;
+- **static** — the gang baseline: a batch only admits into an EMPTY
+  engine (what a fixed-batch ``generate()`` loop does), so a straggler
+  request holds every finished slot hostage.
+
+Both arms warm up their whole compiled set first and then assert the
+steady-state window compiled **nothing** — the graftcheck runtime rule
+``serve-recompile-under-load`` is run in-process and its verdict is part
+of the published record (a p99 that secretly paid a compile is not a
+p99). A fault-chaos sub-run exercises the two serving fault sites:
+``serve.admit``/raise must shed exactly the planned request without
+killing the engine, ``serve.client``/sleep is a slow reader whose stall
+the engine accounts.
+
+One JSON line:
+    {"metric": "serve_slo", "continuous": {p50/p99 latency + TTFT,
+     tokens/sec, occupancy, steady_recompiles}, "static": {...},
+     "continuous_beats_static": bool, "graftcheck_clean": bool, ...}
+
+Env: GRAFT_BENCH_PLATFORM=cpu -> tiny-model CPU self-test;
+GRAFT_SERVE_BENCH_REQUESTS / GRAFT_SERVE_BENCH_GAP_MS resize the trace;
+the engine's own GRAFT_SERVE_* knobs apply on top.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+CPU_SELF_TEST = os.environ.get("GRAFT_BENCH_PLATFORM") == "cpu"
+N_REQUESTS = max(4, int(
+    os.environ.get("GRAFT_SERVE_BENCH_REQUESTS", "24" if CPU_SELF_TEST else "64")
+))
+GAP_MS = float(os.environ.get("GRAFT_SERVE_BENCH_GAP_MS", "2.0"))
+
+
+def build_trace(rng, n, *, mean_gap_s, prompt_lens, max_new_lo, max_new_hi):
+    """Seeded open-loop arrival trace: Poisson gaps, mixed shapes."""
+    from pytorch_distributedtraining_tpu.serve.scheduler import Request
+
+    t = 0.0
+    out = []
+    for rid in range(n):
+        t += float(rng.exponential(mean_gap_s))
+        plen = int(rng.choice(prompt_lens))
+        out.append(Request(
+            rid,
+            rng.integers(0, 64, size=plen).astype("int32"),
+            int(rng.integers(max_new_lo, max_new_hi + 1)),
+            arrival_s=t,
+        ))
+    return out
+
+
+def _pct(vals, q):
+    import numpy as np
+
+    return float(np.percentile(np.asarray(vals, float), q)) if vals else None
+
+
+def _arm(cfg, params, trace, admission, knobs, realtime):
+    """One engine arm over a (copied) trace; returns its summary."""
+    from pytorch_distributedtraining_tpu.serve.engine import ServeEngine
+    from pytorch_distributedtraining_tpu.serve.scheduler import Request
+
+    eng = ServeEngine(cfg, params, admission=admission, **knobs)
+    eng.warmup()
+    eng.mark_steady()
+    # fresh Request objects: scheduler state must not leak across arms
+    reqs = [
+        Request(r.rid, r.prompt.copy(), r.max_new_tokens, r.arrival_s)
+        for r in trace
+    ]
+    t0 = time.perf_counter()
+    records = eng.run(reqs, realtime=realtime)
+    wall = time.perf_counter() - t0
+    lat = [r["latency_s"] for r in records]
+    ttft = [r["ttft_s"] for r in records if r["ttft_s"] is not None]
+    new_tokens = sum(r["new_tokens"] for r in records)
+    m = eng.metrics()
+    return {
+        "admission": admission,
+        "delivered": len(records),
+        "new_tokens": new_tokens,
+        "wall_s": round(wall, 4),
+        "throughput_tok_s": round(new_tokens / wall, 2) if wall else None,
+        "p50_latency_s": _pct(lat, 50),
+        "p99_latency_s": _pct(lat, 99),
+        "p50_ttft_s": _pct(ttft, 50),
+        "p99_ttft_s": _pct(ttft, 99),
+        "mean_slot_occupancy": round(m["mean_slot_occupancy"], 4),
+        "ticks": m["ticks"],
+        "steady_recompiles": m["steady_recompiles"],
+        "compiled_programs": m["compiled_programs"],
+    }
+
+
+def _chaos(cfg, params, knobs):
+    """Fault-site drill: shed one request at admission, stall one reader."""
+    import numpy as np
+
+    from pytorch_distributedtraining_tpu.resilience.faults import (
+        FaultPlan, install_plan,
+    )
+    from pytorch_distributedtraining_tpu.serve.engine import ServeEngine
+    from pytorch_distributedtraining_tpu.serve.scheduler import Request
+
+    rng = np.random.default_rng(7)
+    reqs = [
+        Request(i, rng.integers(0, 64, size=6).astype("int32"), 3,
+                arrival_s=0.0)
+        for i in range(4)
+    ]
+    install_plan(FaultPlan.from_json([
+        {"site": "serve.admit", "action": "raise", "at": 2, "times": 1},
+        {"site": "serve.client", "action": "sleep", "arg": 0.02,
+         "at": 1, "times": 1},
+    ]))
+    try:
+        eng = ServeEngine(cfg, params, **knobs)
+        delivered = eng.run(reqs, realtime=False)
+        m = eng.metrics()
+    finally:
+        install_plan(None)
+    return {
+        "submitted": len(reqs),
+        "delivered": len(delivered),
+        "dropped_at_admit": m["dropped_at_admit"],
+        "slow_reader_stall_s": round(m["slow_reader_stall_s"], 4),
+        "engine_survived": True,
+    }
+
+
+def run_serve_bench(*, realtime: bool = True) -> dict:
+    """In-process bench body (importable — the fast test path)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributedtraining_tpu.analyze.registry import (
+        AnalysisContext, run_rules,
+    )
+    from pytorch_distributedtraining_tpu.models.gpt2 import GPT2, GPT2Config
+    from pytorch_distributedtraining_tpu.observe import trace as telemetry
+    from pytorch_distributedtraining_tpu.observe.goodput import GoodputLedger
+    from pytorch_distributedtraining_tpu.serve import serve_knobs_from_env
+
+    telemetry.enable()
+    if CPU_SELF_TEST:
+        cfg = GPT2Config(
+            vocab_size=64, n_positions=96, n_embd=32, n_layer=2, n_head=2,
+        )
+    else:  # GPT-2 125M, bf16 — the BASELINE ladder's transformer
+        cfg = GPT2Config(dtype=jnp.bfloat16)
+    train_model = GPT2(cfg, decode=False)
+    params = train_model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    knobs = serve_knobs_from_env()
+    if CPU_SELF_TEST:
+        knobs.update(n_slots=3, page_size=8, max_len=48,
+                     prefill_chunk=16, prefill_buckets=(8, 16))
+    rng = np.random.default_rng(0)
+    trace_reqs = build_trace(
+        rng, N_REQUESTS,
+        mean_gap_s=GAP_MS / 1e3,
+        prompt_lens=(4, 7, 12, 20),
+        max_new_lo=4, max_new_hi=10,
+    )
+
+    t_bench0 = time.perf_counter()
+    # throwaway mini-arm: absorb process-wide one-time costs (dtype
+    # conversion jits, first host<->device transfers) that would
+    # otherwise all be billed to whichever measured arm runs first
+    _arm(cfg, params, trace_reqs[:3], "continuous", knobs, False)
+    continuous = _arm(cfg, params, trace_reqs, "continuous", knobs, realtime)
+    static = _arm(cfg, params, trace_reqs, "static", knobs, realtime)
+    chaos = _chaos(cfg, params, knobs)
+
+    # graftcheck runtime plane over the live process: the recompile rule
+    # reads serve.engine.runtime_stats; ERROR findings fail the record
+    report = run_rules(
+        AnalysisContext(platform=jax.default_backend()),
+        planes=("runtime",),
+    )
+    findings = [
+        {"rule": f.rule, "severity": f.severity.name, "message": f.message}
+        for f in report.findings
+    ]
+    serve_findings = [
+        f for f in findings if f["rule"] == "serve-recompile-under-load"
+    ]
+
+    ledger = GoodputLedger.from_tracer(
+        t0=t_bench0, t1=time.perf_counter()
+    )
+    beats = bool(
+        continuous["throughput_tok_s"] and static["throughput_tok_s"]
+        and continuous["throughput_tok_s"] > static["throughput_tok_s"]
+        and continuous["p99_latency_s"] <= static["p99_latency_s"]
+    )
+    return {
+        "metric": "serve_slo",
+        "unit": "summary",
+        "requests": N_REQUESTS,
+        "mean_gap_ms": GAP_MS,
+        "continuous": continuous,
+        "static": static,
+        "continuous_beats_static": beats,
+        "steady_recompiles": continuous["steady_recompiles"],
+        "graftcheck_clean": not serve_findings,
+        "graftcheck_findings": findings,
+        "chaos": chaos,
+        "goodput_fraction": ledger.goodput_fraction(),
+        "time_breakdown": ledger.time_breakdown(),
+    }
+
+
+def main() -> None:
+    if CPU_SELF_TEST:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from pytorch_distributedtraining_tpu.runtime.cache import cache_dir
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir("bench"))
+    record = run_serve_bench()
+    assert record["steady_recompiles"] == 0, (
+        "serving engine recompiled during the steady-state window: "
+        f"{record['graftcheck_findings']}"
+    )
+    assert record["graftcheck_clean"], record["graftcheck_findings"]
+    print(json.dumps(record), flush=True)
+
+
+if __name__ == "__main__":
+    main()

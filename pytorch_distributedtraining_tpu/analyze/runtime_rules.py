@@ -115,3 +115,36 @@ def ckpt_manifest_mismatch(ctx):
         "to match the manifest, or point at the right checkpoint",
         evidence="; ".join(str(m) for m in mismatches[:3]),
     )
+
+
+@rule(
+    "serve-recompile-under-load",
+    "runtime",
+    "serving engine compiled new programs during its steady-state window",
+)
+def serve_recompile_under_load(ctx):
+    # sys.modules, never imported: the engine pulls in jax and this plane
+    # must stay importable from jax-free tooling
+    eng = sys.modules.get("pytorch_distributedtraining_tpu.serve.engine")
+    stats = getattr(eng, "runtime_stats", None)
+    if not stats or not stats.get("steady_windows"):
+        return
+    grew = stats.get("steady_recompiles", 0)
+    if grew <= 0:
+        return
+    yield Finding(
+        "serve-recompile-under-load",
+        Severity.ERROR,
+        "runtime:serve",
+        f"the serving engine compiled {grew} new program(s) AFTER marking "
+        "steady state: some request shape escaped the warmed bucket set, "
+        "so tail latency is paying trace+compile instead of a dispatch — "
+        "exactly the p99 cliff continuous batching exists to remove. Add "
+        "the offending shape to GRAFT_SERVE_BUCKETS (or cap request "
+        "lengths) so warmup covers every dispatchable shape",
+        evidence=(
+            f"jit_entries_at_steady={stats.get('jit_entries_at_steady')} "
+            f"jit_entries_now={stats.get('jit_entries_now')} "
+            f"steady_recompiles={grew}"
+        ),
+    )

@@ -5,10 +5,38 @@ prompt prefill into the Block KV caches (models/gpt2.py ``decode=True``),
 then one `lax.scan` over single-token steps — the whole decode loop is one
 compiled XLA program, cache updates are in-place dynamic slices, and
 sampling (greedy / temperature / top-k / top-p nucleus) is branchless.
+
+Two KV layouts share this module:
+
+- **contiguous** — the original per-batch cache: one ``[B, max_len, H, Dh]``
+  buffer per layer plus a single global position counter. Fast and simple,
+  but the whole batch advances in lockstep, so one finished sequence cannot
+  release its rows to a new request without recompiling at a new shape.
+- **paged** — the serving layout (``serve/``): K/V live in a shared pool of
+  fixed-size pages (``[num_pages, page_size, H, Dh]`` per layer); each batch
+  *slot* owns a page table (physical page ids) and a length. Slots at
+  different positions decode together, finished slots return their pages to
+  the pool, and admission never changes a compiled shape. Physical page 0 is
+  reserved as the **null page**: unassigned page-table entries point at it,
+  so writes from idle slots land in trash instead of another request's KV.
+
+The paged primitives (:func:`write_paged_kv`, :func:`paged_attention`,
+:func:`init_paged_cache`) live here — next to the contiguous twins they
+must stay numerically interchangeable with — and ``serve/kv_cache.py``
+layers the host-side page allocator on top.
+
+Write-before-read invariant (what makes padding and idle slots safe): every
+call writes its chunk's K/V *before* the gather, and queries only attend
+positions ``<= their own``. Padded tail positions of a bucketed prefill
+chunk do scatter garbage past the real length, but any later query at
+position ``p`` first overwrites position ``p`` with its real K/V in the
+same call — so garbage beyond the live length is never read, only
+overwritten.
 """
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import jax
@@ -22,17 +50,24 @@ def sample_logits(logits, rng, *, temperature: float = 1.0,
 
     ``top_k`` keeps the k highest logits; ``top_p`` (nucleus) keeps the
     smallest prefix of the sorted distribution whose mass reaches p. Both
-    filters compose (top-k first).
+    filters compose (top-k first). ``top_k >= vocab`` is a no-op filter —
+    the raw value would index ``sorted_desc[:, top_k - 1]`` out of bounds,
+    which jit's clamping semantics silently turn into a *wrong* filter
+    (the minimum logit as the cutoff of the LAST column it clamps to), so
+    it is clamped to the vocab size here, where the semantics are chosen
+    on purpose.
     """
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
-    want_k = top_k is not None and top_k > 0
+    v = logits.shape[-1]
+    if top_k is not None:
+        top_k = min(int(top_k), v)  # k >= V keeps everything: no filter
+    want_k = top_k is not None and 0 < top_k < v
     want_p = top_p is not None and top_p < 1.0
     if want_k or want_p:
         # one descending sort serves both filters
         sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
-        v = logits.shape[-1]
         rank = jnp.arange(v)[None, :]
         if want_k:
             kth = sorted_desc[:, top_k - 1][:, None]
@@ -52,7 +87,7 @@ def sample_logits(logits, rng, *, temperature: float = 1.0,
 
 
 def init_cache(model, batch_size: int, max_len: int):
-    """Allocate the KV cache for ``batch_size`` x ``max_len`` decoding.
+    """Allocate the contiguous KV cache for ``batch_size`` x ``max_len``.
 
     Shapes come from ``eval_shape`` over ``model.init`` — no params are
     materialized and no forward pass runs; only the zero cache buffers are
@@ -68,6 +103,80 @@ def init_cache(model, batch_size: int, max_len: int):
     )
 
 
+# -- paged KV layout ---------------------------------------------------------
+
+
+def write_paged_kv(k_pages, v_pages, k, v, page_table, lengths):
+    """Scatter a chunk's K/V into the page pool at each slot's position.
+
+    ``k_pages``/``v_pages``: ``[num_pages, page_size, H, Dh]``;
+    ``k``/``v``: ``[B, T, H, Dh]`` new keys/values for positions
+    ``lengths[b] .. lengths[b]+T-1`` of slot ``b``; ``page_table``:
+    ``[B, max_pages]`` physical page ids; ``lengths``: ``[B]``.
+
+    Positions past a slot's allocated pages resolve to the null page
+    (page-table rows are 0-padded), so bucket padding can never corrupt
+    another slot's KV. Returns the updated ``(k_pages, v_pages)``.
+    """
+    page = k_pages.shape[1]
+    t = k.shape[1]
+    pos = lengths[:, None] + jnp.arange(t)[None, :]  # [B, T] global positions
+    slot_page = jnp.clip(pos // page, 0, page_table.shape[1] - 1)
+    phys = jnp.take_along_axis(page_table, slot_page, axis=1)  # [B, T]
+    off = pos % page
+    return k_pages.at[phys, off].set(k), v_pages.at[phys, off].set(v)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, lengths,
+                    softmax_dtype=jnp.float32):
+    """Causal attention of ``q`` against each slot's gathered pages.
+
+    ``q``: ``[B, T, H, Dh]`` queries at global positions
+    ``lengths[b] .. lengths[b]+T-1``. Gathers each slot's pages into a
+    ``[B, max_pages*page, H, Dh]`` view (the paged twin of attending the
+    contiguous buffer) and masks ``kpos <= qpos`` — positions beyond the
+    slot's live length are masked (never-written) or garbage that the
+    write-before-read invariant guarantees is overwritten before any real
+    query reaches it.
+    """
+    b, t, h, dh = q.shape
+    page = k_pages.shape[1]
+    max_len = page_table.shape[1] * page
+    gk = k_pages[page_table].reshape(b, max_len, h, dh)
+    gv = v_pages[page_table].reshape(b, max_len, h, dh)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, gk) / jnp.sqrt(dh).astype(
+        q.dtype
+    )
+    qpos = lengths[:, None] + jnp.arange(t)[None, :]  # [B, T]
+    kpos = jnp.arange(max_len)
+    mask = kpos[None, None, :] <= qpos[:, :, None]  # [B, T, max_len]
+    logits = jnp.where(
+        mask[:, None, :, :], logits, jnp.finfo(logits.dtype).min
+    )
+    probs = jax.nn.softmax(logits.astype(softmax_dtype), -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, gv)
+
+
+def init_paged_cache(model, n_slots: int, max_pages_per_slot: int):
+    """Zero page pool for a paged decode model (``model.paged`` set).
+
+    Same ``eval_shape`` trick as :func:`init_cache`: only the zero page
+    buffers (the ``"pages"`` collection) are allocated.
+    """
+    shapes = jax.eval_shape(
+        model.init,
+        jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((n_slots, 1), jnp.int32),
+        page_table=jax.ShapeDtypeStruct(
+            (n_slots, max_pages_per_slot), jnp.int32
+        ),
+        lengths=jax.ShapeDtypeStruct((n_slots,), jnp.int32),
+    )
+    return jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes["pages"]
+    )
+
+
 def generate(
     model,
     params,
@@ -78,14 +187,21 @@ def generate(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    kv_layout: str = "contiguous",
+    page_size: int = 8,
 ):
     """Returns [B, T_prompt + max_new_tokens] tokens (prompt included).
 
     ``model`` must be constructed with ``decode=True``; its ``n_positions``
-    bounds the total length.
+    bounds the total length. ``kv_layout="paged"`` runs the identical
+    prefill + scan loop against the paged pool layout (each batch row gets
+    a trivial contiguous page table) — the like-for-like proof that the
+    serving engine's cache is token-identical to the contiguous one.
     """
     if not model.decode:
         raise ValueError("generate() needs a model built with decode=True")
+    if kv_layout not in ("contiguous", "paged"):
+        raise ValueError(f"unknown kv_layout {kv_layout!r}")
     rng = jax.random.PRNGKey(0) if rng is None else rng
     b, t_prompt = prompt.shape
     total = t_prompt + max_new_tokens
@@ -93,6 +209,11 @@ def generate(
         raise ValueError(
             f"prompt+new = {total} exceeds n_positions {model.cfg.n_positions}"
         )
+    kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
+
+    if kv_layout == "paged":
+        return _generate_paged(model, params, prompt, max_new_tokens,
+                               rng=rng, page_size=page_size, **kw)
 
     cache = init_cache(model, b, total)
 
@@ -102,10 +223,7 @@ def generate(
     )
     cache = mutated["cache"]
     rng, sub = jax.random.split(rng)
-    next_tok = sample_logits(
-        logits[:, -1], sub, temperature=temperature, top_k=top_k,
-        top_p=top_p,
-    )
+    next_tok = sample_logits(logits[:, -1], sub, **kw)
 
     def step(carry, step_rng):
         cache, tok = carry
@@ -113,16 +231,56 @@ def generate(
             {"params": params, "cache": cache}, tok[:, None],
             mutable=["cache"],
         )
-        nxt = sample_logits(
-            logits[:, -1], step_rng, temperature=temperature, top_k=top_k,
-            top_p=top_p,
-        )
+        nxt = sample_logits(logits[:, -1], step_rng, **kw)
         return (mutated["cache"], nxt), tok
 
     # max_new_tokens - 1 steps: the prefill already sampled token #1, and
     # each step both banks its input token and samples the next
     keys = jax.random.split(rng, max_new_tokens - 1)
     (_, last), toks = jax.lax.scan(step, (cache, next_tok), keys)
+    generated = jnp.concatenate(
+        [toks.T.reshape(b, -1), last[:, None]], axis=1
+    )
+    return jnp.concatenate([prompt, generated.astype(prompt.dtype)], axis=1)
+
+
+def _generate_paged(model, params, prompt, max_new_tokens, *, rng,
+                    page_size, **kw):
+    """The same prefill + scan loop over the paged pool layout."""
+    b, t_prompt = prompt.shape
+    total = t_prompt + max_new_tokens
+    max_pages = math.ceil(total / page_size)
+    # page 0 is the reserved null page; row i owns a contiguous run
+    paged_model = model.clone(paged=(1 + b * max_pages, page_size))
+    page_table = jnp.asarray(
+        1 + jnp.arange(b)[:, None] * max_pages + jnp.arange(max_pages),
+        jnp.int32,
+    )
+    lengths = jnp.zeros((b,), jnp.int32)
+    pages = init_paged_cache(paged_model, b, max_pages)
+
+    logits, mutated = paged_model.apply(
+        {"params": params, "pages": pages}, prompt,
+        page_table=page_table, lengths=lengths, mutable=["pages"],
+    )
+    pages = mutated["pages"]
+    lengths = lengths + t_prompt
+    rng, sub = jax.random.split(rng)
+    next_tok = sample_logits(logits[:, -1], sub, **kw)
+
+    def step(carry, step_rng):
+        pages, lengths, tok = carry
+        logits, mutated = paged_model.apply(
+            {"params": params, "pages": pages}, tok[:, None],
+            page_table=page_table, lengths=lengths, mutable=["pages"],
+        )
+        nxt = sample_logits(logits[:, -1], step_rng, **kw)
+        return (mutated["pages"], lengths + 1, nxt), tok
+
+    keys = jax.random.split(rng, max_new_tokens - 1)
+    (_, _, last), toks = jax.lax.scan(
+        step, (pages, lengths, next_tok), keys
+    )
     generated = jnp.concatenate(
         [toks.T.reshape(b, -1), last[:, None]], axis=1
     )

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 from ..precision import fp8_dot_general_cls
+from .generate import paged_attention, write_paged_kv
 from .scan_utils import remat_block
 
 AttnFn = Callable[..., jnp.ndarray]  # (q, k, v, *, causal) -> out
@@ -139,6 +140,13 @@ class Block(nn.Module):
     land in a ``"cache"`` collection sized by the init-time sequence length,
     and each call attends the new queries against everything cached so far
     (chunked prefill and single-token decode both work).
+
+    ``paged=(num_pages, page_size)`` (with ``decode=True``) switches to the
+    serving layout instead: K/V land in a shared page pool (``"pages"``
+    collection), each batch row is a *slot* addressed by a per-call
+    ``page_table`` + ``lengths``, and slots at different positions decode
+    together (models/generate.py documents the layout and its
+    write-before-read invariant).
     """
 
     cfg: GPT2Config
@@ -146,6 +154,7 @@ class Block(nn.Module):
     decode: bool = False
     # scan-body mode: return (x, None) so the block slots into nn.scan
     as_scan_body: bool = False
+    paged: tuple | None = None  # (num_pages, page_size) page-pool KV layout
 
     def _cached_attention(self, q, k, v, idx):
         """[B, T, H, Dh] step against the persistent cache; ``idx`` is the
@@ -170,8 +179,32 @@ class Block(nn.Module):
         probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
 
+    def _paged_attention(self, q, k, v, page_table, lengths):
+        """[B, T, H, Dh] step against this layer's shared page pool.
+
+        Writes the chunk's K/V at each slot's position, then attends the
+        gathered page view (generate.paged_attention) — the paged twin of
+        :meth:`_cached_attention` with per-slot instead of global position.
+        """
+        n_pages, page = self.paged
+        h, dh = q.shape[2], q.shape[3]
+        is_initialized = self.has_variable("pages", "k_pages")
+        kp = self.variable(
+            "pages", "k_pages", jnp.zeros, (n_pages, page, h, dh), k.dtype
+        )
+        vp = self.variable(
+            "pages", "v_pages", jnp.zeros, (n_pages, page, h, dh), v.dtype
+        )
+        if not is_initialized:  # init pass defines pool shapes only
+            return default_attention(q, k, v, causal=True)
+        kp.value, vp.value = write_paged_kv(
+            kp.value, vp.value, k, v, page_table, lengths
+        )
+        return paged_attention(q, kp.value, vp.value, page_table, lengths)
+
     @nn.compact
-    def __call__(self, x, deterministic: bool = True, start_index=None):
+    def __call__(self, x, deterministic: bool = True, start_index=None,
+                 page_table=None, lengths=None):
         cfg = self.cfg
         d, h = cfg.n_embd, cfg.n_head
         dense = lambda feat, name: nn.Dense(  # noqa: E731
@@ -184,7 +217,11 @@ class Block(nn.Module):
         qkv = dense(3 * d, "c_attn")(y)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         reshape = lambda a: a.reshape(*a.shape[:2], h, d // h)  # noqa: E731
-        if self.decode:
+        if self.decode and self.paged is not None:
+            y = self._paged_attention(
+                reshape(q), reshape(k), reshape(v), page_table, lengths
+            )
+        elif self.decode:
             y = self._cached_attention(
                 reshape(q), reshape(k), reshape(v),
                 jnp.zeros((), jnp.int32) if start_index is None else start_index,
@@ -216,14 +253,22 @@ class GPT2(nn.Module):
     ``decode=True``: incremental KV-cache inference — init with the max
     sequence length to size the cache, then apply token chunks with
     ``mutable=["cache"]`` (see models/generate.py).
+
+    ``decode=True`` + ``paged=(num_pages, page_size)``: paged serving
+    layout — K/V land in a shared page pool (``"pages"`` collection) and
+    every call must pass ``page_table`` [B, max_pages] and ``lengths`` [B]
+    (per-slot positions; there is no global counter, so slots at different
+    sequence positions batch together — the continuous-batching contract).
     """
 
     cfg: GPT2Config = GPT2Config()
     attn_fn: AttnFn = default_attention
     decode: bool = False
+    paged: tuple | None = None  # (num_pages, page_size); needs decode=True
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(self, tokens, deterministic: bool = True, *,
+                 page_table=None, lengths=None):
         cfg = self.cfg
         b, t = tokens.shape
         wte = self.param(
@@ -233,7 +278,21 @@ class GPT2(nn.Module):
             "wpe", nn.initializers.normal(0.01), (cfg.n_positions, cfg.n_embd)
         )
         start_index = None  # blocks' global KV write position this call
-        if self.decode and self.has_variable("cache", "position"):
+        if self.paged is not None:
+            if not self.decode:
+                raise ValueError("paged KV layout requires decode=True")
+            if page_table is None or lengths is None:
+                raise ValueError(
+                    "paged decode needs page_table [B, max_pages] and "
+                    "lengths [B] on every call"
+                )
+            # per-slot positions; clip keeps padded garbage rows in range
+            pos = jnp.clip(
+                lengths[:, None] + jnp.arange(t)[None, :],
+                0, cfg.n_positions - 1,
+            )
+            pe = wpe[pos]  # [B, T, D]
+        elif self.decode and self.has_variable("cache", "position"):
             pos_var = self.variable(
                 "cache", "position", lambda: jnp.zeros((), jnp.int32)
             )
@@ -269,9 +328,10 @@ class GPT2(nn.Module):
         else:
             block_cls = remat_block(Block, cfg.remat)
             for i in range(cfg.n_layer):
-                x = block_cls(cfg, self.attn_fn, self.decode, name=f"h_{i}")(
-                    x, deterministic, start_index
-                )
+                x = block_cls(
+                    cfg, self.attn_fn, self.decode, paged=self.paged,
+                    name=f"h_{i}",
+                )(x, deterministic, start_index, page_table, lengths)
 
         x = nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name="ln_f")(x)
         if cfg.tie_word_embeddings:

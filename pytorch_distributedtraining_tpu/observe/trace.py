@@ -56,6 +56,7 @@ __all__ = [
     "instant",
     "add_span",
     "dispatch_span",
+    "bucket_dispatch_span",
     "note_recompile",
     "enable",
     "disable",
@@ -407,6 +408,31 @@ def dispatch_span(owner, kind: str):
             _TRACER, f"{kind}.compile+dispatch", "compile", {"kind": kind}
         )
     return _LiveSpan(_TRACER, f"{kind}.dispatch", "step", {"kind": kind})
+
+
+def bucket_dispatch_span(owner, kind: str, bucket):
+    """:func:`dispatch_span` for shape-bucketed dispatch families.
+
+    A serving engine runs one compiled program *per bucket shape*
+    (``serve.prefill`` at each chunk bucket, ``serve.decode`` at the slot
+    batch), so warmth is per ``(kind, bucket)``, not per owner: the first
+    dispatch of EACH bucket is a ``compile`` span, every later one is
+    ``step``/productive. The bucket rides on the span attrs so the SLO
+    bench can attribute p99 excursions to a cold bucket.
+    """
+    if not _TRACER.enabled:
+        return _NULL_SPAN
+    warm = getattr(owner, "_telemetry_warm_buckets", None)
+    if warm is None:
+        warm = owner._telemetry_warm_buckets = set()
+    key = (kind, bucket)
+    attrs = {"kind": kind, "bucket": bucket}
+    if key not in warm:
+        warm.add(key)
+        return _LiveSpan(
+            _TRACER, f"{kind}.compile+dispatch", "compile", attrs
+        )
+    return _LiveSpan(_TRACER, f"{kind}.dispatch", "step", attrs)
 
 
 def note_recompile(owner, jitted, kind: str) -> None:

@@ -32,6 +32,13 @@ Named sites (each threaded into the layer that owns it):
                        a simulated total pool outage (``bench.py``)
 ``bench.child``        bench measurement child dies mid-attempt
                        (``bench.py``)
+``serve.admit``        admission controller sheds a request at admission
+                       — ``raise`` drops it, counted, engine keeps serving
+                       (``serve/scheduler.py``)
+``serve.client``       client misbehaves at delivery: ``sleep`` is a slow
+                       reader stalling the tick loop, ``raise`` a
+                       disconnect cancelling the request
+                       (``serve/engine.py``, ``serve/tiles.py``)
 =====================  =====================================================
 
 A plan is JSON — inline in ``GRAFT_FAULT_PLAN`` or a file path — so it
@@ -87,6 +94,8 @@ SITES = frozenset({
     "train.preempt",
     "bench.probe",
     "bench.child",
+    "serve.admit",
+    "serve.client",
 })
 
 

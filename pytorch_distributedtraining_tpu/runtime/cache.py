@@ -22,7 +22,7 @@ ENV_VAR = "GRAFT_COMPILE_CACHE"
 
 __all__ = [
     "cache_dir", "machine_fingerprint", "enable_compile_cache",
-    "cache_entry_count", "ENV_VAR",
+    "cache_entry_count", "jit_cache_size", "ENV_VAR",
 ]
 
 
@@ -78,3 +78,22 @@ def cache_entry_count(path: str | None) -> int:
         return sum(len(files) for _, _, files in os.walk(path))
     except OSError:
         return 0
+
+
+def jit_cache_size(*jitted) -> int:
+    """Total compiled programs across jitted callables.
+
+    The in-process twin of :func:`cache_entry_count`: snapshotting the sum
+    before and after a steady-state window detects mid-run retraces even
+    when the persistent cache is disabled (a serving engine asserts this
+    stays flat once its buckets are warm). Returns 0 for callables whose
+    runtime doesn't expose ``_cache_size`` — absence must read as "no
+    evidence of recompiles", not a recompile.
+    """
+    total = 0
+    for fn in jitted:
+        try:
+            total += int(fn._cache_size())
+        except Exception:  # noqa: BLE001 — introspection, version-dependent
+            pass
+    return total

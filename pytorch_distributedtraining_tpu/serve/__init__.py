@@ -1,0 +1,139 @@
+"""Continuous-batching serving engine (paged KV cache + admission scheduler).
+
+The inference half of the production story: ``models/generate.py`` decodes
+one fixed batch to completion, which is the wrong shape for "heavy traffic
+from millions of users" — requests arrive continuously, finish at different
+times, and a compiled loop that re-specializes per batch makeup pays a
+compile on the p99. This package serves GPT-2 decode (and SwinIR tiled
+super-resolution) at **fixed compiled shapes**:
+
+- :mod:`.kv_cache` — host-side page allocator over the paged KV layout
+  (``models/generate.py`` owns the device-side primitives).
+- :mod:`.scheduler` — FIFO admission + prefill chunk bucketing + slot/page
+  occupancy accounting.
+- :mod:`.engine` — the continuous-batching engine: interleaved chunked
+  prefill + batched decode steps, AOT-warmed bucket shapes, telemetry
+  lanes, fault sites.
+- :mod:`.tiles` — SwinIR request tiling: tile, batch tiles across
+  requests, stitch.
+
+Env knobs (the ``GRAFT_SERVE_*`` family, resolved by
+:func:`serve_knobs_from_env` and consumed by ``Stoke.serve``):
+
+===========================  ==============================================
+``GRAFT_SERVE_SLOTS``        decode batch slots (default 4)
+``GRAFT_SERVE_PAGE``         KV page size in tokens (default 16)
+``GRAFT_SERVE_PAGES``        total pool pages incl. the null page
+                             (default: slots * max_len / page + 1)
+``GRAFT_SERVE_MAX_LEN``      per-request length cap (default: model
+                             ``n_positions``)
+``GRAFT_SERVE_PREFILL_CHUNK`` max prompt tokens per prefill tick
+                             (default 32)
+``GRAFT_SERVE_BUCKETS``      comma-separated prefill chunk buckets
+                             (default "8,16,32")
+``GRAFT_SERVE_TILE``         SwinIR tile edge (default 48)
+``GRAFT_SERVE_TILE_BATCH``   tiles per compiled SwinIR batch (default 4)
+``GRAFT_SERVE_TILE_OVERLAP`` tile overlap in pixels (default 8)
+===========================  ==============================================
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "PagePool",
+    "Request",
+    "AdmissionScheduler",
+    "ServeEngine",
+    "SwinIRTileServer",
+    "serve_knobs_from_env",
+    "build_engine",
+]
+
+
+def serve_knobs_from_env(env=None) -> dict:
+    """Resolve the ``GRAFT_SERVE_*`` knob family into engine kwargs."""
+    e = os.environ if env is None else env
+
+    def _int(name, default):
+        raw = (e.get(name) or "").strip()
+        return int(raw) if raw else default
+
+    buckets_raw = (e.get("GRAFT_SERVE_BUCKETS") or "").strip()
+    buckets = (
+        tuple(sorted(int(x) for x in buckets_raw.split(",") if x.strip()))
+        if buckets_raw else (8, 16, 32)
+    )
+    return dict(
+        n_slots=_int("GRAFT_SERVE_SLOTS", 4),
+        page_size=_int("GRAFT_SERVE_PAGE", 16),
+        num_pages=_int("GRAFT_SERVE_PAGES", 0) or None,
+        max_len=_int("GRAFT_SERVE_MAX_LEN", 0) or None,
+        prefill_chunk=_int("GRAFT_SERVE_PREFILL_CHUNK", 32),
+        prefill_buckets=buckets,
+    )
+
+
+def tile_knobs_from_env(env=None) -> dict:
+    """Resolve the SwinIR tiling knobs (``GRAFT_SERVE_TILE*``)."""
+    e = os.environ if env is None else env
+
+    def _int(name, default):
+        raw = (e.get(name) or "").strip()
+        return int(raw) if raw else default
+
+    return dict(
+        tile=_int("GRAFT_SERVE_TILE", 48),
+        tile_batch=_int("GRAFT_SERVE_TILE_BATCH", 4),
+        overlap=_int("GRAFT_SERVE_TILE_OVERLAP", 8),
+    )
+
+
+def build_engine(model, params, **overrides):
+    """Model-dispatching engine factory (the ``Stoke.serve`` back end).
+
+    GPT-2 family (a ``cfg`` with ``n_positions``) gets a
+    :class:`~.engine.ServeEngine`; SwinIR gets a
+    :class:`~.tiles.SwinIRTileServer`. Env knobs fill anything the caller
+    does not override.
+    """
+    from ..models.gpt2 import GPT2
+    from ..models.swinir import SwinIR
+
+    if isinstance(model, GPT2):
+        from .engine import ServeEngine
+
+        kw = serve_knobs_from_env()
+        kw.update(overrides)
+        return ServeEngine(model.cfg, params, attn_fn=model.attn_fn, **kw)
+    if isinstance(model, SwinIR):
+        from .tiles import SwinIRTileServer
+
+        kw = tile_knobs_from_env()
+        kw.update(overrides)
+        return SwinIRTileServer(model, params, **kw)
+    raise TypeError(
+        f"no serving engine for {type(model).__name__}: GPT2 (continuous-"
+        "batching decode) and SwinIR (tiled super-resolution) are served"
+    )
+
+
+def __getattr__(name):
+    if name in ("PagePool",):
+        from .kv_cache import PagePool
+
+        return PagePool
+    if name in ("Request", "AdmissionScheduler"):
+        from . import scheduler as _s
+
+        return getattr(_s, name)
+    if name == "ServeEngine":
+        from .engine import ServeEngine
+
+        return ServeEngine
+    if name == "SwinIRTileServer":
+        from .tiles import SwinIRTileServer
+
+        return SwinIRTileServer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,428 @@
+"""Continuous-batching GPT-2 decode engine over the paged KV cache.
+
+The engine compiles a **closed set of programs once** and then serves an
+open-ended request stream without ever changing a shape:
+
+- one chunked-prefill program per bucket in ``prefill_buckets`` — B=1,
+  ``[1, bucket]`` tokens against the slot's page-table row. Oversized
+  prompts run as several chunks; the last chunk samples the first new
+  token (TTFT is prefill-bound, not decode-bound).
+- one decode program at ``[n_slots, 1]`` — every slot steps together, each
+  at its own length. Slots without an active decode get a **null page
+  table row** (all zeros → physical page 0) and length 0, so their writes
+  land in trash and their sampled token is ignored on the host.
+
+Admission, retirement, and page accounting are host-side
+(:mod:`.scheduler`), so joining or finishing a request never touches the
+compiled programs — which is the whole point: the p99 of a serving system
+dies by recompiles, and this engine's steady-state window is asserted
+recompile-free (``analyze`` runtime rule ``serve-recompile-under-load``
+reads :data:`runtime_stats`).
+
+Tick loop (one iteration of :meth:`run`):
+
+1. admit queue-head requests into free slots (``serve.admit`` fault site
+   can shed here),
+2. run ONE prefill chunk for the oldest still-prefilling request
+   (chunked prefill interleaves with decode instead of stalling it),
+3. run ONE batched decode step for every decoding slot,
+4. retire finished requests (``serve.client`` fault site at delivery:
+   ``sleep`` = slow reader, ``raise`` = disconnect/cancel), freeing their
+   pages for the next admit.
+
+Telemetry lands in per-bucket lanes (``serve.prefill`` / ``serve.decode``
+via :func:`observe.trace.bucket_dispatch_span`): the first dispatch of
+each bucket is a ``compile`` span, steady dispatches are ``step`` spans
+and therefore count as productive time in the goodput ledger.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generate import init_paged_cache, sample_logits
+from ..models.gpt2 import GPT2, default_attention
+from ..observe import trace
+from ..resilience.faults import InjectedFault, fault_point
+from ..runtime.cache import jit_cache_size
+from .kv_cache import PagePool
+from .scheduler import DECODE, DROPPED, AdmissionScheduler, Request
+
+# Cross-process-visible serving counters for the graftcheck runtime plane
+# (analyze/runtime_rules.py reads this via sys.modules — keep it a plain
+# dict of plain ints). ``steady_recompiles`` > 0 during a steady-state
+# window is the ERROR condition of ``serve-recompile-under-load``.
+runtime_stats = {
+    "engines_built": 0,
+    "steady_windows": 0,
+    "steady_recompiles": 0,
+    "jit_entries_at_steady": 0,
+    "jit_entries_now": 0,
+}
+
+
+class ServeEngine:
+    """Continuous-batching engine for GPT-2 decode.
+
+    ``admission="continuous"`` (the engine) vs ``"static"`` (the gang
+    baseline: a batch admits only into an empty engine, exactly what a
+    fixed-batch ``generate()`` loop does) — the SLO bench runs both over
+    the same arrival trace.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        params,
+        *,
+        attn_fn=default_attention,
+        n_slots: int = 4,
+        page_size: int = 16,
+        num_pages: int | None = None,
+        max_len: int | None = None,
+        prefill_chunk: int = 32,
+        prefill_buckets: tuple[int, ...] = (8, 16, 32),
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        seed: int = 0,
+        admission: str = "continuous",
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.n_slots = int(n_slots)
+        self.page_size = int(page_size)
+        self.max_len = int(max_len or cfg.n_positions)
+        if self.max_len > cfg.n_positions:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds n_positions "
+                f"{cfg.n_positions}"
+            )
+        self.max_pages = math.ceil(self.max_len / self.page_size)
+        # default pool: every slot can hold a max_len request, + null page
+        self.num_pages = int(
+            num_pages or 1 + self.n_slots * self.max_pages
+        )
+        self.prefill_buckets = tuple(sorted(int(b) for b in prefill_buckets))
+        self.prefill_chunk = min(
+            int(prefill_chunk), self.prefill_buckets[-1]
+        )
+        self._sample_kw = dict(
+            temperature=temperature, top_k=top_k, top_p=top_p
+        )
+        self._rng = jax.random.PRNGKey(seed)
+
+        self.pool = PagePool(self.num_pages, self.page_size)
+        self.sched = AdmissionScheduler(
+            n_slots=self.n_slots,
+            pool=self.pool,
+            max_pages_per_slot=self.max_pages,
+            prefill_chunk=self.prefill_chunk,
+            prefill_buckets=self.prefill_buckets,
+            admission=admission,
+        )
+
+        self.model = GPT2(
+            cfg, attn_fn=attn_fn, decode=True,
+            paged=(self.num_pages, self.page_size),
+        )
+        self._pages = init_paged_cache(self.model, 1, self.max_pages)
+        # host mirrors: the physical page table per slot and live lengths
+        self._page_table = np.zeros(
+            (self.n_slots, self.max_pages), np.int32
+        )
+        self._lengths = np.zeros((self.n_slots,), np.int32)
+
+        self._prefill_fns = {
+            b: self._build_prefill(b) for b in self.prefill_buckets
+        }
+        self._decode_fn = self._build_decode()
+        self._warm = False
+        self._steady_jit_entries: int | None = None
+        self.cancelled: list[int] = []  # rids dropped at delivery
+        self.delivered: list[dict] = []
+        self._occupancy_samples: list[float] = []
+        self._tick = 0
+        self._slow_reader_s = 0.0
+        runtime_stats["engines_built"] += 1
+
+    # -- compiled programs -------------------------------------------------
+
+    def _donate(self) -> tuple[int, ...]:
+        # buffer donation is unsupported on CPU (warns, then copies)
+        return (1,) if jax.default_backend() != "cpu" else ()
+
+    def _build_prefill(self, bucket: int):
+        model, kw = self.model, self._sample_kw
+
+        def prefill(params, pages, tokens, ptrow, length, last_idx, rng):
+            logits, mutated = model.apply(
+                {"params": params, "pages": pages}, tokens,
+                page_table=ptrow, lengths=length, mutable=["pages"],
+            )
+            tok = sample_logits(logits[:, last_idx], rng, **kw)
+            return mutated["pages"], tok
+
+        return jax.jit(prefill, donate_argnums=self._donate())
+
+    def _build_decode(self):
+        model, kw = self.model, self._sample_kw
+
+        def decode(params, pages, tokens, page_table, lengths, rng):
+            logits, mutated = model.apply(
+                {"params": params, "pages": pages}, tokens,
+                page_table=page_table, lengths=lengths, mutable=["pages"],
+            )
+            tok = sample_logits(logits[:, -1], rng, **kw)
+            return mutated["pages"], tok
+
+        return jax.jit(decode, donate_argnums=self._donate())
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # -- warmup / steady-state tracking ------------------------------------
+
+    def warmup(self) -> dict:
+        """Compile every program the engine can ever dispatch.
+
+        Runs each prefill bucket and the decode step against the null page
+        table (all writes land in the trash page), so after this no
+        request shape can trigger a compile. Two passes: the fresh pool
+        starts as an uncommitted single-device array, but once params
+        carry a mesh sharding (the Stoke path) the first dispatch returns
+        pages committed to that sharding — a different executable-cache
+        key. The second pass runs every program at that fixed point, so
+        the transition entries compile here, not on a request's p99.
+        Returns a per-program report; :meth:`mark_steady` afterwards arms
+        the recompile watchdog.
+        """
+        null_row = jnp.zeros((1, self.max_pages), jnp.int32)
+        zero_len1 = jnp.zeros((1,), jnp.int32)
+        report = {}
+        for _ in range(2):
+            for b in self.prefill_buckets:
+                t0 = time.perf_counter()
+                with trace.bucket_dispatch_span(self, "serve.prefill", b):
+                    pages, tok = self._prefill_fns[b](
+                        self.params, self._pages,
+                        jnp.zeros((1, b), jnp.int32), null_row, zero_len1,
+                        jnp.int32(b - 1), self._next_rng(),
+                    )
+                    jax.block_until_ready(tok)
+                self._pages = pages
+                report.setdefault(
+                    f"prefill_{b}", time.perf_counter() - t0
+                )
+            t0 = time.perf_counter()
+            with trace.bucket_dispatch_span(
+                self, "serve.decode", self.n_slots
+            ):
+                pages, tok = self._decode_fn(
+                    self.params, self._pages,
+                    jnp.zeros((self.n_slots, 1), jnp.int32),
+                    jnp.zeros((self.n_slots, self.max_pages), jnp.int32),
+                    jnp.zeros((self.n_slots,), jnp.int32),
+                    self._next_rng(),
+                )
+                jax.block_until_ready(tok)
+            self._pages = pages
+            report.setdefault("decode", time.perf_counter() - t0)
+        self._warm = True
+        return report
+
+    def _all_jitted(self):
+        return (*self._prefill_fns.values(), self._decode_fn)
+
+    def mark_steady(self) -> int:
+        """Snapshot the compiled-program count; growth after this point is
+        a steady-state recompile (the thing the SLO bench must never see)."""
+        self._steady_jit_entries = jit_cache_size(*self._all_jitted())
+        runtime_stats["steady_windows"] += 1
+        runtime_stats["jit_entries_at_steady"] = self._steady_jit_entries
+        runtime_stats["jit_entries_now"] = self._steady_jit_entries
+        return self._steady_jit_entries
+
+    def steady_recompiles(self) -> int:
+        """Compiled programs added since :meth:`mark_steady` (0 = clean)."""
+        if self._steady_jit_entries is None:
+            return 0
+        now = jit_cache_size(*self._all_jitted())
+        grew = max(0, now - self._steady_jit_entries)
+        runtime_stats["jit_entries_now"] = now
+        if grew > runtime_stats["steady_recompiles"]:
+            runtime_stats["steady_recompiles"] = grew
+        return grew
+
+    # -- request lifecycle -------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        self.sched.submit(req)
+
+    def _admit(self, now: float) -> None:
+        for st in self.sched.admit(now):
+            # physical pages → 0-padded page-table row (0 = null page)
+            row = np.zeros((self.max_pages,), np.int32)
+            row[: len(st.pages)] = st.pages
+            self._page_table[st.slot] = row
+            self._lengths[st.slot] = 0
+
+    def _prefill_tick(self, now: float) -> bool:
+        st = self.sched.next_prefill()
+        if st is None:
+            return False
+        start, size, bucket = self.sched.prefill_chunk_for(st)
+        chunk = np.zeros((1, bucket), np.int32)
+        chunk[0, :size] = st.req.prompt[start : start + size]
+        with trace.bucket_dispatch_span(self, "serve.prefill", bucket):
+            self._pages, tok = self._prefill_fns[bucket](
+                self.params, self._pages, jnp.asarray(chunk),
+                jnp.asarray(self._page_table[st.slot : st.slot + 1]),
+                jnp.asarray([start], jnp.int32),
+                jnp.int32(size - 1), self._next_rng(),
+            )
+        st.prefilled += size
+        if st.prefilled == st.req.prompt_len:
+            first = int(np.asarray(tok)[0])
+            st.tokens.append(first)
+            st.first_token_s = now
+            st.state = DECODE
+            self._lengths[st.slot] = st.req.prompt_len
+        return True
+
+    def _decode_tick(self, now: float) -> list:
+        active = self.sched.decoding()
+        if not active:
+            return []
+        # decode runs all slots; non-decoding slots get the null row so
+        # their (mandatory — fixed shape) writes land in the trash page
+        pt = np.zeros_like(self._page_table)
+        lens = np.zeros_like(self._lengths)
+        toks = np.zeros((self.n_slots, 1), np.int32)
+        for st in active:
+            pt[st.slot] = self._page_table[st.slot]
+            lens[st.slot] = self._lengths[st.slot]
+            toks[st.slot, 0] = st.tokens[-1]
+        with trace.bucket_dispatch_span(
+            self, "serve.decode", self.n_slots
+        ):
+            self._pages, out = self._decode_fn(
+                self.params, self._pages, jnp.asarray(toks),
+                jnp.asarray(pt), jnp.asarray(lens), self._next_rng(),
+            )
+        out = np.asarray(out)
+        finished = []
+        for st in active:
+            st.tokens.append(int(out[st.slot]))
+            self._lengths[st.slot] += 1
+            if len(st.tokens) >= st.req.max_new_tokens:
+                finished.append(st)
+        return finished
+
+    def _retire(self, finished, now: float) -> None:
+        for st in finished:
+            t0 = time.perf_counter()
+            try:
+                # a "sleep" plan stalls here = slow reader holding the
+                # tick loop; a "raise" plan is a client disconnect
+                fault_point("serve.client", rid=st.rid)
+            except InjectedFault:
+                self.cancelled.append(st.rid)
+                self.sched.retire(st, now, state=DROPPED)
+                self._page_table[st.slot] = 0
+                self._lengths[st.slot] = 0
+                continue
+            finally:
+                self._slow_reader_s += time.perf_counter() - t0
+            self.sched.retire(st, now)
+            self._page_table[st.slot] = 0
+            self._lengths[st.slot] = 0
+            self.delivered.append(self._record(st, now))
+
+    def _record(self, st, now: float) -> dict:
+        arr = st.req.arrival_s
+        return {
+            "rid": st.rid,
+            "prompt_len": st.req.prompt_len,
+            "new_tokens": len(st.tokens),
+            "tokens": list(st.tokens),
+            "latency_s": now - arr,
+            "ttft_s": (
+                None if st.first_token_s is None else st.first_token_s - arr
+            ),
+            "queue_s": st.admitted_s - arr,
+        }
+
+    # -- driving loops -----------------------------------------------------
+
+    def tick(self, now: float) -> None:
+        """One scheduling quantum: admit → prefill chunk → decode → retire."""
+        self._admit(now)
+        self._prefill_tick(now)
+        finished = self._decode_tick(now)
+        self._occupancy_samples.append(
+            len(self.sched.active) / self.n_slots
+        )
+        self._retire(finished, now)
+        self._tick += 1
+
+    def run(self, requests, *, realtime: bool = True) -> list[dict]:
+        """Serve an open-loop trace: each request is submitted at its
+        ``arrival_s`` (relative to loop start). ``realtime=False`` ignores
+        arrival times (everything queues up-front — deterministic tests).
+
+        The engine warms up and arms the steady-state recompile watchdog
+        on first use; returns the per-request delivery records.
+        """
+        if not self._warm:
+            self.warmup()
+        if self._steady_jit_entries is None:
+            self.mark_steady()
+        pending = sorted(requests, key=lambda r: r.arrival_s)
+        t0 = time.monotonic()
+        while pending or not self.sched.idle:
+            now = time.monotonic() - t0 if realtime else float(self._tick)
+            while pending and (
+                not realtime or pending[0].arrival_s <= now
+            ):
+                self.submit(pending.pop(0))
+            if (
+                realtime and pending and self.sched.idle
+                and pending[0].arrival_s > now
+            ):
+                time.sleep(min(0.001, pending[0].arrival_s - now))
+                continue
+            self.tick(now)
+        self.steady_recompiles()
+        return self.delivered
+
+    # -- reporting ---------------------------------------------------------
+
+    def occupancy(self) -> dict:
+        occ = self.sched.occupancy()
+        occ["mean_slot_occupancy"] = (
+            float(np.mean(self._occupancy_samples))
+            if self._occupancy_samples else 0.0
+        )
+        return occ
+
+    def metrics(self) -> dict:
+        """Summary the SLO bench publishes (latency/TTFT percentiles are
+        computed by the bench from the raw records; this is the engine's
+        own accounting)."""
+        return {
+            "delivered": len(self.delivered),
+            "dropped_at_admit": len(self.sched.dropped),
+            "cancelled_at_delivery": len(self.cancelled),
+            "ticks": self._tick,
+            "mean_slot_occupancy": self.occupancy()["mean_slot_occupancy"],
+            "steady_recompiles": self.steady_recompiles(),
+            "compiled_programs": jit_cache_size(*self._all_jitted()),
+            "slow_reader_stall_s": self._slow_reader_s,
+        }

@@ -1,0 +1,106 @@
+"""Host-side page allocator for the paged KV cache.
+
+The device-side layout and its primitives (scatter-write, gathered
+attention, the null-page convention, the write-before-read invariant) live
+in ``models/generate.py`` so the paged and contiguous paths stay
+numerically twinned. This module owns what the *host* must know: which
+physical pages are free, who holds which pages, and the occupancy
+accounting the scheduler and the SLO bench publish.
+
+Design points:
+
+- **Page 0 is the null page** — never allocated. Unassigned page-table
+  entries are 0, so an idle slot's decode writes land in trash instead of
+  another request's KV (``models/generate.py`` documents why that write
+  still happens).
+- Allocation is LIFO over a free stack: a retired request's pages are the
+  *next* pages handed out, which keeps the working set of hot pages small
+  and makes page reuse deterministic for the scheduler tests.
+- The pool never touches jax: admission decisions are host-side and must
+  stay cheap (the engine consults ``available`` every tick).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PagePool:
+    """Free-list over ``num_pages`` physical KV pages (page 0 reserved).
+
+    ``num_pages`` counts the null page, matching the device buffer's
+    leading dimension; ``capacity`` (allocatable pages) is therefore
+    ``num_pages - 1``.
+    """
+
+    num_pages: int
+    page_size: int
+    _free: list[int] = field(default_factory=list)
+    _owned: dict[int, list[int]] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.num_pages < 2:
+            raise ValueError(
+                f"need >= 2 pages (null + 1 allocatable), got "
+                f"{self.num_pages}"
+            )
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {self.page_size}")
+        # LIFO stack, top = lowest id first so allocation order is stable
+        self._free = list(range(self.num_pages - 1, 0, -1))
+
+    # -- accounting --------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (the null page is not capacity)."""
+        return self.num_pages - 1
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.capacity - len(self._free)
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages a sequence of ``n_tokens`` needs."""
+        return -(-max(1, n_tokens) // self.page_size)
+
+    def holder_pages(self, owner) -> list[int]:
+        return list(self._owned.get(owner, ()))
+
+    # -- alloc/free --------------------------------------------------------
+
+    def alloc(self, n: int, owner) -> list[int] | None:
+        """Pop ``n`` pages for ``owner``; None when the pool can't cover it
+        (the caller decides whether that blocks admission)."""
+        if n < 1:
+            raise ValueError(f"alloc of {n} pages")
+        if n > len(self._free):
+            return None
+        got = [self._free.pop() for _ in range(n)]
+        self._owned.setdefault(owner, []).extend(got)
+        return got
+
+    def free(self, owner) -> list[int]:
+        """Return all of ``owner``'s pages to the pool (LIFO: they are the
+        next pages handed out). Returns the freed page ids."""
+        pages = self._owned.pop(owner, [])
+        # reversed: re-push so the earliest-allocated page is on top,
+        # keeping alloc ids stable under churn
+        self._free.extend(reversed(pages))
+        return pages
+
+    def check_invariants(self) -> None:
+        """Occupancy must sum to capacity; page 0 must never be owned."""
+        owned = [p for ps in self._owned.values() for p in ps]
+        assert 0 not in owned, "null page was allocated"
+        assert 0 not in self._free, "null page is on the free list"
+        assert len(owned) + len(self._free) == self.capacity, (
+            f"pages leaked: {len(owned)} owned + {len(self._free)} free "
+            f"!= {self.capacity} capacity"
+        )
+        assert len(set(owned)) == len(owned), "page double-allocated"

@@ -1657,6 +1657,23 @@ class Stoke:
                 f"{int(self._state.step)}"
             )
 
+    def serve(self, **overrides):
+        """Build a serving engine over the live params (``serve/``).
+
+        GPT-2 gets the continuous-batching :class:`~..serve.engine.
+        ServeEngine` (paged KV cache, chunked prefill, fixed compiled
+        shapes); SwinIR gets the tiled
+        :class:`~..serve.tiles.SwinIRTileServer`. Defaults come from the
+        ``GRAFT_SERVE_*`` env family (slots, page size, prefill buckets,
+        tile size — see ``serve/__init__.py``); keyword ``overrides``
+        win over env. The engine snapshots the current params — later
+        training steps do not leak into in-flight generations.
+        """
+        self._require_state()
+        from ..serve import build_engine
+
+        return build_engine(self._module, self._state.params, **overrides)
+
     def export_trace(self, path: str | None = None) -> str | None:
         """Write recorded telemetry spans as Chrome trace-event JSON.
 

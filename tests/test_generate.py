@@ -153,3 +153,72 @@ class TestTopP:
                 top_k=3, top_p=0.75,
             )
             assert int(tok[0]) in (2, 3)
+
+    def test_top_k_at_least_vocab_is_no_filter(self):
+        """top_k >= V must be a no-op, not an out-of-bounds cutoff.
+
+        Unclamped, ``sorted_desc[:, top_k - 1]`` would clamp to the LAST
+        column under jit — making the MINIMUM logit the cutoff, i.e. a
+        wrong filter rather than no filter.
+        """
+        logits = jnp.asarray([[0.4, 1.0, 0.2, 0.7]])
+        v = logits.shape[-1]
+        for seed in range(12):
+            rng = jax.random.PRNGKey(seed)
+            base = sample_logits(logits, rng, temperature=1.0)
+            for k in (v, v + 1, 999):
+                tok = sample_logits(logits, rng, temperature=1.0, top_k=k)
+                assert int(tok[0]) == int(base[0]), (seed, k)
+
+    def test_top_k_at_vocab_keeps_all_tokens_reachable(self):
+        logits = jnp.asarray([[0.0, 0.0, 0.0, 0.0]])
+        seen = {
+            int(sample_logits(
+                logits, jax.random.PRNGKey(s), temperature=1.0, top_k=999
+            )[0])
+            for s in range(40)
+        }
+        assert len(seen) >= 3  # a wrong cutoff would pin one token
+
+
+class TestPagedLayout:
+    """kv_layout="paged" must be token-identical to contiguous."""
+
+    def test_paged_generate_matches_contiguous_greedy(self, params):
+        model = GPT2(CFG, decode=True)
+        prompt = jnp.asarray(
+            np.random.default_rng(3).integers(0, CFG.vocab_size, (2, 9)),
+            jnp.int32,
+        )
+        ref = generate(model, params, prompt, 10, temperature=0.0)
+        paged = generate(
+            model, params, prompt, 10, temperature=0.0,
+            kv_layout="paged", page_size=4,
+        )
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(paged))
+
+    def test_paged_generate_matches_contiguous_sampled(self, params):
+        model = GPT2(CFG, decode=True)
+        prompt = jnp.asarray(
+            np.random.default_rng(4).integers(0, CFG.vocab_size, (3, 5)),
+            jnp.int32,
+        )
+        rng = jax.random.PRNGKey(11)
+        ref = generate(
+            model, params, prompt, 8, rng=rng, temperature=1.0, top_p=0.9
+        )
+        # same rng + same masked-softmax numerics -> same draws; page
+        # size that does NOT divide the prompt exercises mid-page writes
+        paged = generate(
+            model, params, prompt, 8, rng=rng, temperature=1.0, top_p=0.9,
+            kv_layout="paged", page_size=3,
+        )
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(paged))
+
+    def test_paged_rejects_unknown_layout(self, params):
+        model = GPT2(CFG, decode=True)
+        with pytest.raises(ValueError, match="kv_layout"):
+            generate(
+                model, params, jnp.zeros((1, 4), jnp.int32), 2,
+                kv_layout="ring",
+            )

@@ -1,0 +1,638 @@
+"""Continuous-batching serving engine: pages, scheduler, engine, tiles.
+
+The load-bearing guarantees, in dependency order: the page pool never
+leaks or double-allocates; admission is FIFO-deterministic and a retired
+request's exact pages go to the next admit; the paged attention
+primitives match dense attention; the engine's output is token-identical
+to ``generate()`` batch decode; steady-state serving compiles nothing
+(and the graftcheck rule fires when it would); faults shed/stall without
+killing the engine; SwinIR tiling stitches exactly.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributedtraining_tpu.analyze import (
+    AnalysisContext,
+    Severity,
+    run_rules,
+)
+from pytorch_distributedtraining_tpu.models import GPT2, GPT2Config
+from pytorch_distributedtraining_tpu.models.generate import (
+    generate,
+    paged_attention,
+    write_paged_kv,
+)
+from pytorch_distributedtraining_tpu.observe import trace
+from pytorch_distributedtraining_tpu.resilience.faults import (
+    FaultPlan,
+    install_plan,
+)
+from pytorch_distributedtraining_tpu.serve import (
+    build_engine,
+    serve_knobs_from_env,
+    tile_knobs_from_env,
+)
+from pytorch_distributedtraining_tpu.serve.engine import (
+    ServeEngine,
+    runtime_stats,
+)
+from pytorch_distributedtraining_tpu.serve.kv_cache import PagePool
+from pytorch_distributedtraining_tpu.serve.scheduler import (
+    DECODE,
+    PREFILL,
+    AdmissionScheduler,
+    Request,
+    bucket_for,
+    chunk_plan,
+)
+from pytorch_distributedtraining_tpu.serve.tiles import (
+    SwinIRTileServer,
+    TileRequest,
+    tile_grid,
+)
+
+CFG = GPT2Config.tiny(n_embd=32, n_head=4, n_positions=96)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = GPT2(CFG)
+    tok = jnp.zeros((1, 8), jnp.int32)
+    return model.init(jax.random.PRNGKey(0), tok)["params"]
+
+
+def _prompt(rng, n):
+    return rng.integers(0, CFG.vocab_size, size=n).astype(np.int32)
+
+
+class TestPagePool:
+    def test_null_page_reserved_and_alloc_order(self):
+        pool = PagePool(num_pages=6, page_size=4)
+        assert pool.capacity == 5
+        got = pool.alloc(3, owner="a")
+        assert got == [1, 2, 3]  # lowest ids first, never page 0
+        pool.check_invariants()
+
+    def test_free_is_lifo_and_exact(self):
+        pool = PagePool(num_pages=8, page_size=4)
+        a = pool.alloc(2, "a")
+        b = pool.alloc(2, "b")
+        assert (a, b) == ([1, 2], [3, 4])
+        freed = pool.free("a")
+        assert freed == [1, 2]
+        # a's pages are the NEXT pages handed out, in the same order
+        assert pool.alloc(2, "c") == [1, 2]
+        pool.check_invariants()
+
+    def test_insufficient_returns_none_not_partial(self):
+        pool = PagePool(num_pages=4, page_size=2)
+        assert pool.alloc(5, "a") is None
+        assert pool.available == 3  # nothing was consumed
+        pool.check_invariants()
+
+    def test_pages_for_ceil_division(self):
+        pool = PagePool(num_pages=4, page_size=8)
+        assert pool.pages_for(1) == 1
+        assert pool.pages_for(8) == 1
+        assert pool.pages_for(9) == 2
+        assert pool.pages_for(0) == 1  # a request always holds a page
+
+    def test_rejects_degenerate_pools(self):
+        with pytest.raises(ValueError):
+            PagePool(num_pages=1, page_size=4)
+        with pytest.raises(ValueError):
+            PagePool(num_pages=4, page_size=0)
+
+
+class TestBuckets:
+    def test_bucket_for_picks_smallest_cover(self):
+        assert bucket_for(3, (8, 16, 32)) == 8
+        assert bucket_for(8, (8, 16, 32)) == 8
+        assert bucket_for(9, (8, 16, 32)) == 16
+        with pytest.raises(ValueError):
+            bucket_for(33, (8, 16, 32))
+
+    def test_chunk_plan_covers_prompt_exactly(self):
+        plan = chunk_plan(21, 8, (4, 8))
+        assert plan == [(0, 8, 8), (8, 8, 8), (16, 5, 8)]
+        assert sum(size for _, size, _ in plan) == 21
+
+
+class TestScheduler:
+    def _sched(self, n_slots=2, pages=9, page=4, **kw):
+        pool = PagePool(pages, page)
+        return AdmissionScheduler(
+            n_slots=n_slots, pool=pool, max_pages_per_slot=4,
+            prefill_chunk=8, prefill_buckets=(4, 8), **kw
+        ), pool
+
+    def test_admission_is_fifo_and_mixed_lengths_bucket_right(self):
+        sched, _ = self._sched()
+        rng = np.random.default_rng(0)
+        # prompt 3 -> bucket 4; prompt 7 -> bucket 8; prompt 11 -> 8 then 4
+        for rid, (plen, mnew) in enumerate([(3, 2), (7, 2), (11, 2)]):
+            sched.submit(Request(rid, _prompt(rng, plen), mnew))
+        admitted = sched.admit()
+        assert [st.rid for st in admitted] == [0, 1]  # FIFO, 2 slots
+        assert [st.slot for st in admitted] == [0, 1]  # lowest-id first
+        st0, st1 = admitted
+        assert sched.prefill_chunk_for(st0) == (0, 3, 4)
+        assert sched.prefill_chunk_for(st1) == (0, 7, 8)
+        # the queued request's plan splits across buckets
+        assert chunk_plan(11, 8, (4, 8)) == [(0, 8, 8), (8, 3, 4)]
+
+    def test_retired_pages_are_reused_by_next_admit(self):
+        sched, pool = self._sched(n_slots=1)
+        rng = np.random.default_rng(1)
+        sched.submit(Request(0, _prompt(rng, 4), 4))  # 8 tokens -> 2 pages
+        sched.submit(Request(1, _prompt(rng, 4), 4))
+        (st0,) = sched.admit()
+        pages0 = list(st0.pages)
+        assert pages0 == [1, 2]
+        st0.state = DECODE
+        freed = sched.retire(st0)
+        assert freed == pages0
+        (st1,) = sched.admit()
+        # the EXACT pages (and the slot) cycle to the next request
+        assert st1.pages == pages0
+        assert st1.slot == st0.slot
+        pool.check_invariants()
+
+    def test_head_of_line_blocks_until_pages_free(self):
+        sched, pool = self._sched(n_slots=2, pages=5)  # 4 allocatable
+        rng = np.random.default_rng(2)
+        sched.submit(Request(0, _prompt(rng, 8), 8))   # 16 tok -> 4 pages
+        sched.submit(Request(1, _prompt(rng, 2), 2))   # 1 page, but queued
+        admitted = sched.admit()
+        assert [st.rid for st in admitted] == [0]
+        assert sched.admit() == []  # head fits a slot but not the pool? no-
+        # rid 1 IS the head now and needs 1 page with 0 free: blocked
+        occ = sched.occupancy()
+        assert occ["queued"] == 1 and occ["pages_free"] == 0
+        admitted[0].state = DECODE
+        sched.retire(admitted[0])
+        assert [st.rid for st in sched.admit()] == [1]
+
+    def test_occupancy_sums_to_capacity(self):
+        sched, pool = self._sched(n_slots=2, pages=9)
+        rng = np.random.default_rng(3)
+        sched.submit(Request(0, _prompt(rng, 4), 4))
+        sched.submit(Request(1, _prompt(rng, 6), 2))
+        sched.admit()
+        occ = sched.occupancy()
+        assert occ["pages_in_use"] + occ["pages_free"] == occ["pages_capacity"]
+        assert occ["slots_active"] + occ["slots_free"] == occ["slots_total"]
+        assert occ["prefilling"] == 2 and occ["decoding"] == 0
+
+    def test_static_admission_waits_for_empty_engine(self):
+        sched, _ = self._sched(n_slots=2, admission="static")
+        rng = np.random.default_rng(4)
+        for rid in range(3):
+            sched.submit(Request(rid, _prompt(rng, 3), 2))
+        assert [st.rid for st in sched.admit()] == [0, 1]
+        assert sched.admit() == []  # a live batch blocks ALL admission
+        for st in list(sched.active.values()):
+            st.state = DECODE
+            sched.retire(st)
+        assert [st.rid for st in sched.admit()] == [2]
+
+    def test_oversized_request_rejected_at_submit(self):
+        sched, _ = self._sched()
+        with pytest.raises(ValueError, match="max_pages_per_slot"):
+            sched.submit(Request(0, np.zeros(30, np.int32), 30))
+
+
+class TestPagedPrimitives:
+    def test_write_then_gather_matches_dense_causal(self):
+        """Paged scatter+gather attention == plain dense causal attention."""
+        rng = np.random.default_rng(0)
+        b, t, h, dh, page, max_pages = 2, 6, 2, 4, 4, 3
+        q, k, v = (
+            jnp.asarray(rng.standard_normal((b, t, h, dh)), jnp.float32)
+            for _ in range(3)
+        )
+        kp = jnp.zeros((1 + b * max_pages, page, h, dh))
+        vp = jnp.zeros_like(kp)
+        table = jnp.asarray(
+            1 + np.arange(b)[:, None] * max_pages + np.arange(max_pages),
+            jnp.int32,
+        )
+        lengths = jnp.zeros((b,), jnp.int32)
+        kp, vp = write_paged_kv(kp, vp, k, v, table, lengths)
+        out = paged_attention(q, kp, vp, table, lengths)
+
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(dh)
+        mask = np.tril(np.ones((t, t), bool))
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        ref = jnp.einsum(
+            "bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1), v
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-5
+        )
+
+    def test_null_page_takes_oob_writes(self):
+        """Writes past a slot's pages land in page 0, not a neighbor's KV."""
+        h, dh, page = 1, 2, 2
+        kp = jnp.zeros((4, page, h, dh))
+        vp = jnp.zeros_like(kp)
+        # slot 0 owns pages [1] only; slot 1 owns [2, 3]
+        table = jnp.asarray([[1, 0], [2, 3]], jnp.int32)
+        # slot 0 writes ones (the potential corruption); slot 1 writes
+        # zeros so any nonzero in its pages must have come from slot 0
+        k = jnp.stack([jnp.ones((1, h, dh)), jnp.zeros((1, h, dh))])
+        v = k
+        # slot 0 writes at position 3 -> page index 1 -> its table says 0
+        lengths = jnp.asarray([3, 0], jnp.int32)
+        kp2, _ = write_paged_kv(kp, vp, k, v, table, lengths)
+        assert float(jnp.abs(kp2[2]).sum()) == 0.0  # slot 1 untouched
+        assert float(jnp.abs(kp2[3]).sum()) == 0.0
+        assert float(jnp.abs(kp2[0]).sum()) > 0.0   # trash went to null page
+
+
+class TestEngine:
+    def _engine(self, params, **kw):
+        base = dict(
+            n_slots=3, page_size=8, max_len=48,
+            prefill_chunk=16, prefill_buckets=(8, 16), temperature=0.0,
+        )
+        base.update(kw)
+        return ServeEngine(CFG, params, **base)
+
+    def test_e2e_token_identical_to_generate(self, params):
+        """Mixed prompt lengths through the continuous engine == per-
+        request greedy generate() — the core serving correctness claim."""
+        eng = self._engine(params)
+        rng = np.random.default_rng(0)
+        prompts = [_prompt(rng, n) for n in (5, 11, 3, 20, 7)]
+        max_new = [6, 4, 8, 5, 7]
+        reqs = [
+            Request(i, p, m) for i, (p, m) in enumerate(zip(prompts, max_new))
+        ]
+        records = eng.run(reqs, realtime=False)
+        assert len(records) == len(reqs)
+        model = GPT2(CFG, decode=True)
+        for r in records:
+            ref = generate(
+                model, params, jnp.asarray(prompts[r["rid"]])[None, :],
+                max_new[r["rid"]], temperature=0.0,
+            )
+            ref_new = np.asarray(ref)[0, len(prompts[r["rid"]]):].tolist()
+            assert r["tokens"] == ref_new, r["rid"]
+
+    def test_zero_steady_recompiles_and_occupancy(self, params):
+        eng = self._engine(params)
+        rng = np.random.default_rng(1)
+        reqs = [Request(i, _prompt(rng, 6), 4) for i in range(6)]
+        eng.run(reqs, realtime=False)
+        m = eng.metrics()
+        assert m["steady_recompiles"] == 0
+        assert m["compiled_programs"] == len(eng.prefill_buckets) + 1
+        assert 0.0 < m["mean_slot_occupancy"] <= 1.0
+
+    def test_pages_cycle_across_requests(self, params):
+        """More requests than pool capacity forces reuse; all must finish."""
+        eng = self._engine(params, n_slots=2, num_pages=2 * 6 + 1)
+        rng = np.random.default_rng(2)
+        reqs = [Request(i, _prompt(rng, 8), 4) for i in range(5)]
+        records = eng.run(reqs, realtime=False)
+        assert len(records) == 5
+        assert eng.pool.in_use == 0  # everything returned
+        eng.pool.check_invariants()
+
+    def test_admit_fault_sheds_request_not_engine(self, params):
+        install_plan(FaultPlan.from_json([
+            {"site": "serve.admit", "action": "raise", "at": 2, "times": 1},
+        ]))
+        try:
+            eng = self._engine(params)
+            rng = np.random.default_rng(3)
+            reqs = [Request(i, _prompt(rng, 4), 3) for i in range(4)]
+            records = eng.run(reqs, realtime=False)
+        finally:
+            install_plan(None)
+        assert len(records) == 3
+        assert eng.metrics()["dropped_at_admit"] == 1
+        assert [r.rid for r in eng.sched.dropped] == [1]  # the 2nd admit
+
+    def test_client_fault_cancels_and_slow_reader_accounted(self, params):
+        install_plan(FaultPlan.from_json([
+            {"site": "serve.client", "action": "raise", "at": 1, "times": 1},
+            {"site": "serve.client", "action": "sleep", "arg": 0.01,
+             "at": 2, "times": 1},
+        ]))
+        try:
+            eng = self._engine(params)
+            rng = np.random.default_rng(4)
+            reqs = [Request(i, _prompt(rng, 4), 3) for i in range(3)]
+            records = eng.run(reqs, realtime=False)
+        finally:
+            install_plan(None)
+        m = eng.metrics()
+        assert m["cancelled_at_delivery"] == 1
+        assert len(records) == 2
+        assert m["slow_reader_stall_s"] >= 0.01
+        assert eng.pool.in_use == 0  # cancelled request freed its pages
+
+    def test_static_admission_gang_schedules(self, params):
+        eng = self._engine(params, admission="static", n_slots=2)
+        rng = np.random.default_rng(5)
+        reqs = [Request(i, _prompt(rng, 4), 3 + i) for i in range(4)]
+        records = eng.run(reqs, realtime=False)
+        assert len(records) == 4
+        # gang semantics: nothing from batch 2 may finish before ALL of
+        # batch 1 is out (the straggler holds the batch)
+        done_order = [r["rid"] for r in records]
+        assert set(done_order[:2]) == {0, 1}
+
+
+class TestGraftcheckRule:
+    def _reset(self, **kw):
+        saved = dict(runtime_stats)
+        runtime_stats.update({
+            "engines_built": 1, "steady_windows": 1,
+            "steady_recompiles": 0, "jit_entries_at_steady": 3,
+            "jit_entries_now": 3,
+        })
+        runtime_stats.update(kw)
+        return saved
+
+    def test_fires_error_on_steady_growth(self):
+        saved = self._reset(steady_recompiles=2, jit_entries_now=5)
+        try:
+            report = run_rules(
+                AnalysisContext(platform="cpu"), planes=("runtime",),
+                ignore=frozenset(),
+            )
+            hits = [
+                f for f in report.findings
+                if f.rule == "serve-recompile-under-load"
+            ]
+            assert len(hits) == 1
+            assert hits[0].severity is Severity.ERROR
+            assert "jit_entries_now=5" in hits[0].evidence
+        finally:
+            runtime_stats.update(saved)
+
+    def test_silent_when_steady_window_clean(self):
+        saved = self._reset()
+        try:
+            report = run_rules(
+                AnalysisContext(platform="cpu"), planes=("runtime",),
+                ignore=frozenset(),
+            )
+            assert not [
+                f for f in report.findings
+                if f.rule == "serve-recompile-under-load"
+            ]
+        finally:
+            runtime_stats.update(saved)
+
+    def test_silent_when_no_steady_window(self):
+        saved = self._reset(steady_windows=0, steady_recompiles=9)
+        try:
+            report = run_rules(
+                AnalysisContext(platform="cpu"), planes=("runtime",),
+                ignore=frozenset(),
+            )
+            assert not [
+                f for f in report.findings
+                if f.rule == "serve-recompile-under-load"
+            ]
+        finally:
+            runtime_stats.update(saved)
+
+
+class TestTelemetry:
+    def test_bucket_span_compile_then_step(self):
+        trace.enable()
+        trace.clear()
+
+        class Owner:
+            pass
+
+        o = Owner()
+        for _ in range(3):
+            with trace.bucket_dispatch_span(o, "serve.prefill", 8):
+                pass
+        with trace.bucket_dispatch_span(o, "serve.prefill", 16):
+            pass
+        recs = [r for r in trace.records() if "serve.prefill" in r["name"]]
+        cats = [r["cat"] for r in recs]
+        # first dispatch of EACH bucket compiles; repeats are steps
+        assert cats == ["compile", "step", "step", "compile"]
+        assert recs[0]["attrs"]["bucket"] == 8
+        assert recs[3]["attrs"]["bucket"] == 16
+        trace.clear()
+
+    def test_engine_emits_bucket_lanes(self, params):
+        trace.enable()
+        trace.clear()
+        eng = ServeEngine(
+            CFG, params, n_slots=2, page_size=8, max_len=32,
+            prefill_chunk=8, prefill_buckets=(8,), temperature=0.0,
+        )
+        rng = np.random.default_rng(6)
+        eng.run([Request(0, _prompt(rng, 4), 3)], realtime=False)
+        names = {r["name"] for r in trace.records()}
+        assert "serve.prefill.compile+dispatch" in names
+        assert "serve.decode.compile+dispatch" in names
+        assert "serve.decode.dispatch" in names  # steady decode = step lane
+        trace.clear()
+
+
+class TestTiles:
+    def test_grid_covers_and_stays_in_bounds(self):
+        for h, w, tile, ov in [(100, 70, 48, 8), (48, 48, 48, 8),
+                               (97, 51, 32, 4)]:
+            grid = tile_grid(h, w, tile, ov)
+            cov = np.zeros((h, w), bool)
+            for y, x in grid:
+                assert y + tile <= h and x + tile <= w
+                cov[y : y + tile, x : x + tile] = True
+            assert cov.all(), (h, w, tile, ov)
+
+    def test_grid_rejects_bad_args(self):
+        with pytest.raises(ValueError):
+            tile_grid(10, 100, 48, 8)
+        with pytest.raises(ValueError):
+            tile_grid(100, 100, 48, 48)
+
+    class _Identity:
+        upscale = 1
+
+        def apply(self, variables, x):
+            return x * 2.0
+
+    def test_stitch_is_exact_for_linear_model(self):
+        srv = SwinIRTileServer(
+            self._Identity(), {}, tile=32, tile_batch=3, overlap=8
+        )
+        rng = np.random.default_rng(0)
+        imgs = [
+            rng.random((80, 50, 3)).astype(np.float32),
+            rng.random((10, 20, 3)).astype(np.float32),  # < tile: padded
+        ]
+        recs = srv.run([TileRequest(i, im) for i, im in enumerate(imgs)])
+        assert len(recs) == 2
+        for r in recs:
+            np.testing.assert_allclose(
+                r["image"], imgs[r["rid"]] * 2.0, atol=1e-5
+            )
+            assert r["image"].shape == imgs[r["rid"]].shape
+
+    def test_batches_mix_requests(self):
+        srv = SwinIRTileServer(
+            self._Identity(), {}, tile=32, tile_batch=4, overlap=0
+        )
+        rng = np.random.default_rng(1)
+        # two 2-tile images: tick 1 must take tiles from BOTH requests
+        imgs = [rng.random((32, 64, 3)).astype(np.float32) for _ in range(2)]
+        for i, im in enumerate(imgs):
+            srv.submit(TileRequest(i, im))
+        srv.warmup()
+        srv.tick(0.0)
+        assert srv.metrics()["mean_batch_occupancy"] == 1.0
+        assert len(srv.delivered) == 2  # one full batch finished both
+
+    def test_swinir_e2e_tiny(self):
+        from pytorch_distributedtraining_tpu.models.swinir import SwinIR
+
+        model = SwinIR(
+            upscale=2, embed_dim=8, depths=(1,), num_heads=(2,),
+            window_size=4, img_size=8,
+        )
+        x = jnp.zeros((1, 16, 16, 3), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        srv = SwinIRTileServer(model, params, tile=16, tile_batch=2,
+                               overlap=4)
+        rng = np.random.default_rng(2)
+        img = rng.random((24, 20, 3)).astype(np.float32)
+        recs = srv.run([TileRequest(0, img)])
+        assert len(recs) == 1
+        out = recs[0]["image"]
+        assert out.shape == (48, 40, 3)  # upscale 2
+        assert np.isfinite(out).all()
+        assert srv.metrics()["steady_recompiles"] == 0
+
+    def test_client_fault_cancels_tile_request(self):
+        install_plan(FaultPlan.from_json([
+            {"site": "serve.client", "action": "raise", "at": 1,
+             "times": 1},
+        ]))
+        try:
+            srv = SwinIRTileServer(
+                self._Identity(), {}, tile=16, tile_batch=2, overlap=0
+            )
+            rng = np.random.default_rng(3)
+            recs = srv.run([
+                TileRequest(0, rng.random((16, 16, 3)).astype(np.float32)),
+                TileRequest(1, rng.random((16, 16, 3)).astype(np.float32)),
+            ])
+        finally:
+            install_plan(None)
+        assert srv.cancelled == [0]
+        assert [r["rid"] for r in recs] == [1]
+
+
+class TestFactoryAndFacade:
+    def test_env_knobs_resolve(self):
+        env = {
+            "GRAFT_SERVE_SLOTS": "8", "GRAFT_SERVE_PAGE": "4",
+            "GRAFT_SERVE_BUCKETS": "16,4", "GRAFT_SERVE_TILE": "64",
+        }
+        kw = serve_knobs_from_env(env)
+        assert kw["n_slots"] == 8 and kw["page_size"] == 4
+        assert kw["prefill_buckets"] == (4, 16)  # sorted
+        assert kw["num_pages"] is None  # unset -> engine default
+        assert tile_knobs_from_env(env)["tile"] == 64
+
+    def test_build_engine_dispatches_on_model(self, params):
+        eng = build_engine(
+            GPT2(CFG), params, n_slots=2, page_size=8, max_len=32,
+            prefill_chunk=8, prefill_buckets=(8,),
+        )
+        assert isinstance(eng, ServeEngine)
+        with pytest.raises(TypeError, match="no serving engine"):
+            build_engine(object(), params)
+
+    def test_stoke_serve_builds_engine(self):
+        from pytorch_distributedtraining_tpu import losses
+        from pytorch_distributedtraining_tpu.stoke import (
+            Stoke,
+            StokeOptimizer,
+        )
+
+        stoke = Stoke(
+            model=GPT2(CFG),
+            optimizer=StokeOptimizer(
+                optimizer="AdamW", optimizer_kwargs={"lr": 1e-3}
+            ),
+            loss=losses.mse_loss,
+            verbose=False,
+        )
+        with pytest.raises(RuntimeError, match="not initialized"):
+            stoke.serve()
+        stoke.init(jnp.zeros((1, 8), jnp.int32))
+        eng = stoke.serve(
+            n_slots=2, page_size=8, max_len=32,
+            prefill_chunk=8, prefill_buckets=(8,),
+        )
+        assert isinstance(eng, ServeEngine)
+        rng = np.random.default_rng(7)
+        recs = eng.run([Request(0, _prompt(rng, 4), 3)], realtime=False)
+        assert len(recs) == 1 and len(recs[0]["tokens"]) == 3
+
+
+class TestServeBench:
+    def test_in_process_record_shape(self, monkeypatch):
+        monkeypatch.setenv("GRAFT_BENCH_PLATFORM", "cpu")
+        bench_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmarks",
+        )
+        monkeypatch.syspath_prepend(bench_dir)
+        import importlib
+
+        import serve_bench
+
+        importlib.reload(serve_bench)
+        rec = serve_bench.run_serve_bench(realtime=False)
+        assert rec["metric"] == "serve_slo"
+        for arm in ("continuous", "static"):
+            assert rec[arm]["delivered"] == rec["requests"]
+            assert rec[arm]["steady_recompiles"] == 0
+            assert rec[arm]["p99_latency_s"] >= rec[arm]["p50_latency_s"]
+        # identical traces decode identical token totals in both arms
+        assert rec["continuous"]["new_tokens"] == rec["static"]["new_tokens"]
+        assert rec["graftcheck_clean"] is True
+        assert rec["chaos"]["dropped_at_admit"] == 1
+        assert rec["chaos"]["engine_survived"] is True
+
+    @pytest.mark.slow
+    def test_subprocess_publishes_json(self):
+        bench = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmarks", "serve_bench.py",
+        )
+        env = dict(
+            os.environ, GRAFT_BENCH_PLATFORM="cpu", JAX_PLATFORMS="cpu"
+        )
+        proc = subprocess.run(
+            [sys.executable, bench], env=env, capture_output=True,
+            text=True, timeout=600, cwd=os.path.dirname(bench),
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "serve_slo"
+        assert rec["steady_recompiles"] == 0
+        assert rec["graftcheck_clean"] is True
+        assert rec["continuous"]["throughput_tok_s"] > 0
