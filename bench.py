@@ -503,6 +503,7 @@ def _recovery_arm() -> None:
     out = os.path.join(workdir, "events.jsonl")
     ckpt = os.path.join(workdir, "ckpt")
     crash_step = int(os.environ.get("GRAFT_BENCH_RECOVERY_STEP", "4"))
+    grow = os.environ.get("GRAFT_BENCH_RECOVERY_GROW", "") == "1"
     plan = {
         "faults": [
             # tear: bg writer for step K-1 sleeps past the kill, so its
@@ -533,16 +534,28 @@ def _recovery_arm() -> None:
             env.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=4"
         ).strip()
+    if grow:
+        # grow-back leg: the shrunken generation dawdles so the launcher's
+        # capacity probes can fire, then takes the graceful teardown and
+        # the next generation resumes with mode=grow on the larger mesh
+        env.setdefault("GRAFT_DRILL_GROW", "1")
+        env.setdefault("GRAFT_DRILL_STEP_SLEEP_S", "0.25")
+        env["GRAFT_DRILL_STEPS"] = str(crash_step + 12)
+        env.setdefault("GRAFT_GROW_PROBES", "2")
+        env.setdefault("GRAFT_GROW_PROBE_INTERVAL_S", "0.3")
+        env.setdefault("GRAFT_GROW_MIN_INTERVAL_S", "3")
     from pytorch_distributedtraining_tpu.runtime import recovery_drill
     cmd = [
         sys.executable, "-m",
         "pytorch_distributedtraining_tpu.runtime.launch",
         "--nproc_per_node=2", "--max_restarts=2",
-        "--elastic", "--min_world=1", recovery_drill.__file__,
+        "--elastic", "--min_world=1",
+        *(["--grow"] if grow else []),
+        recovery_drill.__file__,
     ]
     _status(
         f"recovery arm: tear ckpt@{crash_step - 1}, kill@{crash_step}, "
-        f"elastic 2->? ranks"
+        f"elastic 2->? ranks" + (", then grow back" if grow else "")
     )
     t0 = time.monotonic()
     try:
@@ -566,6 +579,17 @@ def _recovery_arm() -> None:
             events = [json.loads(l) for l in fh if l.strip()]
     except (OSError, ValueError) as e:
         _emit_error(f"recovery arm: unreadable event stream: {e}")
+        return
+    skip = next((e for e in events if e["event"] == "skip"), None)
+    if skip is not None:
+        # capability gap (no local jax world on this image): a structured
+        # skip record, rc 0 — never a red bench for a missing backend
+        _emit_result(json.dumps({
+            "metric": "time_to_grow_s" if grow else "time_to_recover_s",
+            "skipped": True,
+            "unit": "s",
+            "reason": skip.get("reason", ""),
+        }))
         return
     steps0 = [e for e in events if e["event"] == "step" and e["attempt"] == 0]
     resume = next((e for e in events if e["event"] == "resume"), None)
@@ -600,6 +624,42 @@ def _recovery_arm() -> None:
         "committed_steps": done.get("committed", []),
         "launcher_wall_s": round(wall_s, 3),
     }
+    if grow:
+        g_resume = next(
+            (e for e in events
+             if e["event"] == "resume" and e.get("mode") == "grow"),
+            None,
+        )
+        bit = next(
+            (e for e in events if e["event"] == "grow_bitwise"), None
+        )
+        if g_resume is None:
+            _emit_error(
+                "recovery arm: grow generation never resumed (grow gate "
+                "never fired?)"
+            )
+            return
+        g_att = g_resume["attempt"]
+        pre_grow = [
+            e for e in events
+            if e["event"] in ("step", "preempt_exit")
+            and 0 < e["attempt"] < g_att
+        ]
+        first_grown = next(
+            (e for e in events
+             if e["event"] == "step" and e["attempt"] == g_att),
+            None,
+        )
+        if not pre_grow or first_grown is None:
+            _emit_error("recovery arm: grow generation produced no steps")
+            return
+        record["time_to_grow_s"] = round(
+            first_grown["t"] - max(e["t"] for e in pre_grow), 3
+        )
+        record["grow_world_to"] = g_resume["world"]
+        record["grow_mesh_to"] = g_resume["fsdp"]
+        record["grow_resume_step"] = g_resume["step"]
+        record["grow_bitwise_ok"] = bool(bit and bit.get("ok"))
     _emit_result(json.dumps(record))
 
 

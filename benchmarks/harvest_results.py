@@ -44,6 +44,10 @@ STAGES = [
     ("recovery", "elastic recovery drill: time_to_recover_s through a "
                  "torn-checkpoint tear + preemption kill + shrink-to-"
                  "survive resume (bench.py, GRAFT_BENCH_RECOVERY=1)"),
+    ("grow", "elastic grow-back drill: shrink 2->1, then health-gated "
+             "grow back to 2 with a bitwise reshard check — "
+             "time_to_grow_s (bench.py, GRAFT_BENCH_RECOVERY=1 "
+             "GRAFT_BENCH_RECOVERY_GROW=1)"),
     ("dispatch_probe", "tunnel dispatch-cost decomposition (dispatch_probe.py)"),
     ("bench_scan_k10", "bench.py, fused + lax.scan k=10 per dispatch"),
     ("bench_scan_k25", "bench.py, fused + lax.scan k=25 per dispatch"),
@@ -98,8 +102,9 @@ ARM_KNOBS = {
     "bench_pp": "GRAFT_PP=4 GRAFT_PP_SCHEDULE=1f1b",
     "bench_wire_int8": "GRAFT_WIRE=int8",
     "bench_wire_fp8": "GRAFT_WIRE=fp8_e4m3",
-    # pool-free robustness arm (unit "s", never an A/B throughput winner)
+    # pool-free robustness arms (unit "s", never an A/B throughput winner)
     "recovery": "GRAFT_BENCH_RECOVERY=1",
+    "grow": "GRAFT_BENCH_RECOVERY=1 GRAFT_BENCH_RECOVERY_GROW=1",
     # serving SLO arm (summary record; continuous-vs-static lives inside)
     "serve": "GRAFT_BENCH_SERVE=1",
 }

@@ -118,6 +118,57 @@ def ckpt_manifest_mismatch(ctx):
 
 
 @rule(
+    "elastic-flap",
+    "runtime",
+    "membership epochs advancing faster than the grow hysteresis allows",
+)
+def elastic_flap(ctx):
+    # sys.modules, never imported: membership is stdlib-only but lives in
+    # the runtime package whose __init__ pulls jax — this plane must stay
+    # importable from jax-free tooling
+    ms = sys.modules.get(
+        "pytorch_distributedtraining_tpu.runtime.membership"
+    )
+    stats = getattr(ms, "runtime_stats", None)
+    if not stats:
+        return
+    window_s = stats.get("hysteresis_window_s")
+    limit = stats.get("flap_limit")
+    advances = stats.get("epoch_advances") or []
+    if window_s is None or not limit or len(advances) <= limit:
+        return
+    # count epoch bumps inside any sliding hysteresis window: more than
+    # `limit` world transitions within one window means the gate is being
+    # overridden faster than it can damp — a flapping host is thrashing
+    # the run through save/relaunch cycles instead of being quarantined
+    window = max(float(window_s), 1.0)
+    worst = 0
+    lo = 0
+    for hi in range(len(advances)):
+        while advances[hi] - advances[lo] > window:
+            lo += 1
+        worst = max(worst, hi - lo + 1)
+    if worst <= limit:
+        return
+    yield Finding(
+        "elastic-flap",
+        Severity.ERROR,
+        "runtime:membership",
+        f"membership epochs advanced {worst} times within one "
+        f"{window:.0f}s hysteresis window (flap limit {limit}): a host "
+        "is flapping — joining, being grown onto, and dying — and every "
+        "cycle costs a forced save + relaunch + reshard. Raise "
+        "GRAFT_GROW_PROBES / GRAFT_GROW_MIN_INTERVAL_S so admission "
+        "needs a longer healthy streak, or quarantine the host "
+        "(its failures may be misclassified as external)",
+        evidence=(
+            f"epoch_advances={len(advances)} worst_window={worst} "
+            f"window_s={window:.0f} flap_limit={limit}"
+        ),
+    )
+
+
+@rule(
     "serve-recompile-under-load",
     "runtime",
     "serving engine compiled new programs during its steady-state window",

@@ -72,6 +72,10 @@ runtime_stats: dict = {
     "last_snapshot_s": None,
     "last_write_error": None,
     "manifest_mismatches": [],
+    # preemption-forced saves (SIGTERM agreement path): the elastic
+    # launcher's graceful teardown relies on exactly one of these landing
+    # before the relaunch, so the count is worth surfacing
+    "forced_saves": 0,
     # which process these counters describe: only rank 0 runs the commit,
     # so commits_observed is structurally 0 on ranks > 0 (the analyzer's
     # ckpt-commits-silent rule must not read that as a dead writer)
@@ -873,6 +877,11 @@ class CheckpointManager:
         anywhere = self._preempted_anywhere()
         if scheduled or anywhere:
             self._preempted.clear()
+            if anywhere:
+                runtime_stats["forced_saves"] += 1
+                telemetry.instant(
+                    "ckpt.preempt_save", "checkpoint", step=step
+                )
             path = self.save(step, state)
             if anywhere:
                 # the job is about to die: the save must be ON DISK on

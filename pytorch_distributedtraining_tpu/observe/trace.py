@@ -85,6 +85,7 @@ CATEGORIES = (
     "collective",  # explicit cross-process sync (barriers, agreements)
     "outage",      # riding a pool outage / retry backoff
     "fault",       # injected-fault instants (resilience/faults.py)
+    "membership",  # elastic membership transitions (runtime/membership.py)
     "other",
 )
 

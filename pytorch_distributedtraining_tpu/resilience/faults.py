@@ -32,6 +32,13 @@ Named sites (each threaded into the layer that owns it):
                        a simulated total pool outage (``bench.py``)
 ``bench.child``        bench measurement child dies mid-attempt
                        (``bench.py``)
+``launch.grow``        elastic launcher is about to initiate a grow-back
+                       reshard — ``raise`` vetoes this grow attempt (the
+                       gate re-arms), ``sleep`` delays the teardown
+                       (``runtime/launch.py``)
+``membership.heartbeat`` a host's membership heartbeat is dropped — the
+                       host ages out of the live set and cannot be grown
+                       onto (``runtime/membership.py``)
 ``serve.admit``        admission controller sheds a request at admission
                        — ``raise`` drops it, counted, engine keeps serving
                        (``serve/scheduler.py``)
@@ -85,6 +92,8 @@ _VALID_ACTIONS = ("raise", "oserror", "exit", "kill", "sigterm", "sleep")
 
 SITES = frozenset({
     "launch.worker",
+    "launch.grow",
+    "membership.heartbeat",
     "dist.rendezvous",
     "collective.barrier",
     "loader.fetch",

@@ -116,6 +116,41 @@ def external_termination(rc: int | None) -> bool:
     return rc is None or rc in (-9, -15, 124, 137, 143)
 
 
+# crash signatures that point at the HOST rather than the code or the
+# pool: memory/bus faults and illegal instructions are the classic
+# bad-DIMM / cooked-chip ways a machine eats a rank, and a hardware
+# sentinel in the tail is the driver saying so outright
+_HOST_FAULT_RCS = frozenset({-11, -7, -4, -8, 139, 135, 132, 136})
+_HOST_FAULT_SENTINELS = (
+    "uncorrectable ecc",
+    "hbm error",
+    "device failure",
+    "hardware error",
+    "machine check",
+    "bus error",
+    "segmentation fault",
+)
+
+
+def attributes_to_host(rc: int | None, tail: str = "") -> bool:
+    """True when a rank's failure is plausibly the HOST's fault — the
+    elastic launcher's quarantine discriminator.
+
+    An external termination (preemption/OOM-kill/timeout) says the pool
+    took the worker: the host is innocent and stays admissible for
+    grow-back. A SIGSEGV/SIGBUS/SIGILL/SIGFPE death, or a hardware
+    sentinel in the diagnostic tail, says the machine itself ate the
+    rank — growing back onto it would just crash the next generation,
+    so it enters quarantine with exponential backoff instead.
+    """
+    if rc is not None and rc in _HOST_FAULT_RCS:
+        return True
+    if external_termination(rc):
+        return False
+    low = tail.lower()
+    return any(s in low for s in _HOST_FAULT_SENTINELS)
+
+
 def classify_exception(exc: BaseException) -> OutageClass:
     """:func:`classify` for in-process exceptions (rendezvous, W&B, I/O).
 

@@ -196,6 +196,8 @@ def initialize(
         and not explicit_coordinator
         and not jax_native_rendezvous
     )
+    _note_membership_rank(up=True)
+
     if single_process:
         logger.debug("dist.initialize: single-process run; nothing to do")
         _INITIALIZED = True
@@ -259,6 +261,32 @@ def initialize(
     )
 
 
+def _note_membership_rank(up: bool = True) -> None:
+    """Rank-level liveness into the elastic membership store, when the
+    launcher exported one (``GRAFT_MEMBERSHIP`` — directory-backed only).
+
+    This is how a launcher monitoring the store can see REMOTE rank
+    deaths: a rank that registered ``up`` and then stopped refreshing has
+    died with its machine, even though no local exit code exists for it.
+    Best-effort by design — membership must never break initialization.
+    """
+    location = os.environ.get("GRAFT_MEMBERSHIP")
+    if not location or location.startswith("tcp://"):
+        return
+    if "RANK" not in os.environ:
+        return
+    try:
+        from .membership import MembershipStore
+
+        MembershipStore(location).note_rank(
+            rank=int(os.environ["RANK"]),
+            host_id=f"node{os.environ.get('GRAFT_NODE_RANK', '0')}",
+            up=up,
+        )
+    except (OSError, ValueError):
+        logger.debug("membership rank note failed", exc_info=True)
+
+
 def process_count_if_initialized() -> int:
     """Process count WITHOUT initializing a backend.
 
@@ -316,6 +344,7 @@ def shutdown() -> None:
     if not _INITIALIZED:
         return
     _INITIALIZED = False
+    _note_membership_rank(up=False)
     if jax.process_count() > 1:
         try:
             jax.distributed.shutdown()

@@ -7,21 +7,41 @@ markers, the launcher's shrink-to-survive decision, and an N→M resharded
 resume on the surviving (smaller) world — then reports every step as a
 JSONL event stream the bench parent turns into ``time_to_recover_s``.
 
+With ``GRAFT_DRILL_GROW=1`` (and the launcher run with ``--grow``), the
+drill also exercises grow-back: the shrunken generation trains slowly
+enough for the launcher's capacity probes to fire, takes the graceful
+SIGTERM teardown (forcing a preemption checkpoint through the manager's
+signal path), and the next generation resumes with
+``GRAFT_RECOVERY_MODE=grow`` on the larger mesh — where it proves the
+grow reshard is BITWISE faithful by re-reading the same committed step
+onto a single-device mesh and comparing every param and optimizer-moment
+leaf (event ``grow_bitwise``). The bench parent turns the gap between the
+last pre-grow step and the first post-grow step into ``time_to_grow_s``.
+
 Topology note: this image's CPU backend refuses cross-process collectives,
 so the drill deliberately runs its jax world LOCAL to rank 0 — rank 0
 trains a tiny ZeRO-2 model on a virtual-device mesh sized from
 ``WORLD_SIZE`` (``fsdp = min(4, 2 * world)``), while every other rank is a
 passive stdlib worker standing in for a machine that can be preempted.
 Shrinking the launcher world 2 → 1 therefore halves the mesh (fsdp 4 → 2)
-and the resume genuinely reshards params AND optimizer moments.
+and the resume genuinely reshards params AND optimizer moments; growing
+back doubles it again.
+
+On images where even the local jax world cannot be built (no jax, or a
+backend that refuses the virtual-device mesh), the drill emits a
+structured ``skip`` event and exits 0 — a missing capability is a skip
+record, never a red bench.
 
 Env contract (all inherited through the launcher):
 
 - ``RANK`` / ``WORLD_SIZE`` / ``GRAFT_RESTART_ATTEMPT`` — launcher contract.
-- ``GRAFT_RECOVERY_MODE`` — launcher's shrink/retry decision (gen > 0).
+- ``GRAFT_RECOVERY_MODE`` — launcher's shrink/retry/grow decision (gen > 0).
 - ``GRAFT_DRILL_OUT``   — JSONL event file (appended across generations).
 - ``GRAFT_DRILL_CKPT``  — checkpoint root shared across generations.
 - ``GRAFT_DRILL_STEPS`` — total train steps to reach (default 6).
+- ``GRAFT_DRILL_GROW``  — exercise grow-back (see above).
+- ``GRAFT_DRILL_STEP_SLEEP_S`` — per-step dawdle so the shrunken
+  generation survives until the launcher's grow probes fire.
 - ``GRAFT_FAULT_PLAN``  — the chaos schedule (``ckpt.write`` tear +
   ``train.preempt`` kill), consumed inside the checkpoint layer.
 """
@@ -46,12 +66,31 @@ def _emit(path: str, **event) -> None:
         os.close(fd)
 
 
+# error-text sentinels that mean "this image cannot run the drill's local
+# jax world at all" — a capability gap, not a recovery-path failure
+_SKIP_SENTINELS = (
+    "not implemented",
+    "multiprocess",
+    "no devices",
+    "unable to initialize backend",
+    "failed to initialize",
+)
+
+
+def _is_capability_gap(exc: BaseException) -> bool:
+    if isinstance(exc, ImportError):
+        return True
+    low = f"{type(exc).__name__}: {exc}".lower()
+    return any(s in low for s in _SKIP_SENTINELS)
+
+
 def _worker_main(done_marker: str) -> int:
     """Passive non-zero rank: a preemptible machine, not a jax process.
 
     Exits 0 once rank 0 writes the done marker; a monitor SIGTERM (fate
-    sharing after rank 0 dies) terminates it with the default -15, which
-    the launcher's n_failed accounting correctly ignores.
+    sharing after rank 0 dies, or a graceful grow teardown) terminates it
+    with the default -15, which the launcher's n_failed accounting
+    correctly ignores.
     """
     signal.signal(signal.SIGTERM, signal.SIG_DFL)
     while not os.path.exists(done_marker):
@@ -59,11 +98,59 @@ def _worker_main(done_marker: str) -> int:
     return 0
 
 
+def _bitwise_check(ckpt_root, step, state, make_ref):
+    """Prove the grow reshard changed no bits: re-read the same committed
+    step onto a single-device mesh and compare every leaf of the resumed
+    (grown, sharded) state against it. Returns a list of differing leaf
+    paths (empty = bitwise identical)."""
+    import jax
+    import numpy as np
+
+    from pytorch_distributedtraining_tpu.checkpoint_sharded import (
+        reshard_restore,
+    )
+
+    ref_mesh, ref_template = make_ref()
+    path = os.path.join(ckpt_root, f"step_{step:010d}")
+    ref_state = reshard_restore(path, ref_mesh, ref_template)
+    flat_got = jax.tree_util.tree_flatten_with_path(state)[0]
+    flat_ref = jax.tree_util.tree_flatten_with_path(ref_state)[0]
+    ref_by_path = {
+        jax.tree_util.keystr(p): leaf for p, leaf in flat_ref
+    }
+    bad = []
+    for p, leaf in flat_got:
+        pstr = jax.tree_util.keystr(p)
+        ref = ref_by_path.get(pstr)
+        if ref is None or not hasattr(leaf, "dtype"):
+            continue
+        a = np.asarray(jax.device_get(leaf))
+        b = np.asarray(jax.device_get(ref))
+        if a.dtype != b.dtype or a.shape != b.shape or not np.array_equal(
+            a, b, equal_nan=True
+        ):
+            bad.append(pstr)
+    return bad
+
+
 def _trainer_main(out: str, ckpt_root: str, done_marker: str) -> int:
     world = int(os.environ.get("WORLD_SIZE", "1"))
     attempt = int(os.environ.get("GRAFT_RESTART_ATTEMPT", "0"))
     mode = os.environ.get("GRAFT_RECOVERY_MODE", "")
     total_steps = int(os.environ.get("GRAFT_DRILL_STEPS", "6"))
+    step_sleep_s = float(os.environ.get("GRAFT_DRILL_STEP_SLEEP_S", "0"))
+    grow_drill = os.environ.get("GRAFT_DRILL_GROW", "") == "1"
+
+    # a graceful teardown (grow, or a remote host's failure) arrives as
+    # SIGTERM: the manager's handler (chained onto this one) forces the
+    # preemption save, and this flag tells the loop to exit cleanly after
+    # it instead of dawdling until the launcher escalates to SIGKILL
+    sigterm_seen = {"flag": False}
+
+    def _note_sigterm(signum, frame):
+        sigterm_seen["flag"] = True
+
+    signal.signal(signal.SIGTERM, _note_sigterm)
 
     # local virtual-device mesh BEFORE importing jax; never touch
     # jax.distributed — cross-process CPU collectives don't exist here
@@ -74,27 +161,39 @@ def _trainer_main(out: str, ckpt_root: str, done_marker: str) -> int:
             flags + " --xla_force_host_platform_device_count=4"
         ).strip()
 
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
 
-    from pytorch_distributedtraining_tpu import optim
-    from pytorch_distributedtraining_tpu.checkpoint_sharded import (
-        CheckpointManager,
-    )
-    from pytorch_distributedtraining_tpu.models import Net
-    from pytorch_distributedtraining_tpu.parallel import (
-        TrainStep,
-        ZeRO2,
-        create_train_state,
-    )
-    from pytorch_distributedtraining_tpu.runtime.mesh import (
-        MeshSpec,
-        make_mesh,
-    )
+        from pytorch_distributedtraining_tpu import optim
+        from pytorch_distributedtraining_tpu.checkpoint_sharded import (
+            CheckpointManager,
+        )
+        from pytorch_distributedtraining_tpu.models import Net
+        from pytorch_distributedtraining_tpu.parallel import (
+            TrainStep,
+            ZeRO2,
+            create_train_state,
+        )
+        from pytorch_distributedtraining_tpu.runtime.mesh import (
+            MeshSpec,
+            make_mesh,
+        )
 
-    fsdp = min(4, 2 * world)
-    mesh = make_mesh(MeshSpec.zero(fsdp), devices=jax.devices()[:fsdp])
+        fsdp = min(4, 2 * world)
+        mesh = make_mesh(MeshSpec.zero(fsdp), devices=jax.devices()[:fsdp])
+    except Exception as e:  # noqa: BLE001 — capability triage below
+        if _is_capability_gap(e):
+            _emit(
+                out, event="skip", attempt=attempt,
+                reason=f"{type(e).__name__}: {e}"[:300],
+            )
+            with open(done_marker, "w") as fh:
+                fh.write("skip\n")  # release the passive worker ranks
+            return 0
+        raise
+
     model = Net(upscale_factor=2)
     tx = optim.adamw(lr=1e-3, clip_grad_norm=1.0)
     policy = ZeRO2(min_shard_size=1)
@@ -104,12 +203,15 @@ def _trainer_main(out: str, ckpt_root: str, done_marker: str) -> int:
         out_img = model.apply({"params": params}, lr_img)
         return jnp.mean((out_img - hr) ** 2), {}
 
-    state, sh = create_train_state(
-        init_fn=lambda r: (
-            model.init(r, jnp.zeros((1, 8, 8, 3)))["params"], {},
-        ),
-        tx=tx, mesh=mesh, policy=policy,
-    )
+    def _make_state(target_mesh):
+        return create_train_state(
+            init_fn=lambda r: (
+                model.init(r, jnp.zeros((1, 8, 8, 3)))["params"], {},
+            ),
+            tx=tx, mesh=target_mesh, policy=policy,
+        )
+
+    state, sh = _make_state(mesh)
     step_fn = TrainStep(
         loss_fn, tx, mesh, policy, state_shardings=sh, donate=False
     )
@@ -136,6 +238,23 @@ def _trainer_main(out: str, ckpt_root: str, done_marker: str) -> int:
             out, event="resume", step=start, attempt=attempt, world=world,
             fsdp=fsdp, mode=mode, torn_dirs=torn,
         )
+        if grow_drill and mode == "grow":
+            # the grown mesh must carry EXACTLY the bits the checkpoint
+            # holds — compare against an independent single-device read
+            def _make_ref():
+                ref_mesh = make_mesh(
+                    MeshSpec.zero(1), devices=jax.devices()[:1]
+                )
+                ref_state, _ = _make_state(ref_mesh)
+                return ref_mesh, ref_state
+
+            bad = _bitwise_check(ckpt_root, start, state, _make_ref)
+            _emit(
+                out, event="grow_bitwise", step=start, attempt=attempt,
+                fsdp=fsdp, ok=not bad, differing=bad[:8],
+            )
+            if bad:
+                return 1
 
     try:
         s = state
@@ -149,6 +268,18 @@ def _trainer_main(out: str, ckpt_root: str, done_marker: str) -> int:
                     out, event="step", step=int(s.step), attempt=attempt,
                     world=world, fsdp=fsdp,
                 )
+                if sigterm_seen["flag"]:
+                    # the preemption save above already committed and
+                    # drained (forced-save path); leave before the
+                    # launcher has to escalate
+                    mgr.wait()
+                    _emit(
+                        out, event="preempt_exit", step=int(s.step),
+                        attempt=attempt, world=world, fsdp=fsdp,
+                    )
+                    return 0
+                if step_sleep_s > 0:
+                    time.sleep(step_sleep_s)
         mgr.wait()
     finally:
         mgr.close()

@@ -1,0 +1,369 @@
+"""Membership store, quarantine/backoff, grow hysteresis, TCP proxy, and
+the graftcheck ``elastic-flap`` runtime rule."""
+
+import threading
+import time
+
+import pytest
+
+from pytorch_distributedtraining_tpu.resilience.outage import (
+    attributes_to_host,
+)
+from pytorch_distributedtraining_tpu.runtime.membership import (
+    GrowGate,
+    MembershipStore,
+    TCPMembershipStore,
+    open_store,
+    reset_runtime_stats,
+    runtime_stats,
+    serve_store,
+)
+
+
+class FakeClock:
+    def __init__(self, t0: float = 1000.0):
+        self.t = t0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def store(tmp_path, clock):
+    return MembershipStore(
+        str(tmp_path / "ms"), ttl_s=10.0,
+        quarantine_base_s=60.0, quarantine_max_s=600.0, clock=clock,
+    )
+
+
+class TestStoreBasics:
+    def test_register_heartbeat_ttl(self, store, clock):
+        store.register_host("node0", capacity=4, node_rank=0)
+        store.register_host("node1", capacity=4, node_rank=1)
+        assert [h["host_id"] for h in store.hosts()] == ["node0", "node1"]
+        # node1 stops heartbeating: it ages out of the live set
+        clock.advance(8.0)
+        store.heartbeat("node0")
+        clock.advance(5.0)
+        live = store.hosts()
+        assert [h["host_id"] for h in live] == ["node0"]
+        # re-registration is idempotent and revives liveness
+        store.register_host("node1", capacity=4, node_rank=1)
+        assert len(store.hosts()) == 2
+
+    def test_heartbeat_unregistered_raises(self, store):
+        with pytest.raises(KeyError):
+            store.heartbeat("ghost")
+
+    def test_bad_host_id_rejected(self, store):
+        with pytest.raises(ValueError):
+            store.register_host("../escape", capacity=1)
+
+    def test_rank_liveness(self, store, clock):
+        store.note_rank(0, host_id="node0", up=True)
+        store.note_rank(1, host_id="node1", up=True)
+        assert {r["rank"] for r in store.live_ranks()} == {0, 1}
+        store.note_rank(1, host_id="node1", up=False)
+        assert {r["rank"] for r in store.live_ranks()} == {0}
+        clock.advance(20.0)  # stale notes age out like heartbeats
+        assert store.live_ranks() == []
+
+    def test_generation_roundtrip(self, store):
+        epoch = store.bump_epoch(world=4, mode="start", reason="launch")
+        store.publish_generation(
+            epoch=epoch, world=4, assignments=[["node0", 2], ["node1", 2]],
+            port=1234, mode=None, attempt=0,
+        )
+        doc = store.read_generation()
+        assert doc["epoch"] == epoch and doc["world"] == 4
+        assert doc["assignments"] == [["node0", 2], ["node1", 2]]
+        # wait_generation returns immediately once the epoch is visible
+        got = store.wait_generation(min_epoch=epoch, timeout_s=1.0)
+        assert got["epoch"] == epoch
+        assert store.wait_generation(
+            min_epoch=epoch + 1, timeout_s=0.3, poll_s=0.05
+        ) is None
+
+    def test_results_and_teardown(self, store):
+        store.post_result(epoch=3, host_id="node0", code=0, n_failed=0)
+        store.post_result(
+            epoch=3, host_id="node1", code=-9, n_failed=2, rcs=[-9, -9]
+        )
+        rs = {r["host_id"]: r for r in store.results(epoch=3)}
+        assert rs["node1"]["rcs"] == [-9, -9]
+        assert store.results(epoch=2) == []
+        assert store.teardown_requested(epoch=3) is None
+        store.request_teardown(epoch=3, reason="peer-failure")
+        assert store.teardown_requested(epoch=3)["reason"] == "peer-failure"
+        assert store.teardown_requested(epoch=4) is None
+
+    def test_transitions_recorded(self, store):
+        store.register_host("node0", capacity=2)
+        store.bump_epoch(world=2, mode="start")
+        kinds = [t["kind"] for t in store.transitions()]
+        assert kinds == ["register", "epoch"]
+
+
+class TestQuarantine:
+    def test_attributed_failure_quarantines_with_backoff(self, store, clock):
+        store.register_host("node1", capacity=2)
+        store.record_failure("node1", rc=-11, attributed=True)
+        assert store.is_quarantined("node1")
+        assert store.quarantine_remaining_s("node1") == pytest.approx(60.0)
+        # backoff doubles per round...
+        clock.advance(61.0)
+        assert not store.is_quarantined("node1")
+        store.record_failure("node1", rc=-11, attributed=True)
+        assert store.quarantine_remaining_s("node1") == pytest.approx(120.0)
+        # ...and caps at quarantine_max_s
+        for _ in range(6):
+            clock.advance(1000.0)
+            store.record_failure("node1", rc=-11, attributed=True)
+        assert store.quarantine_remaining_s("node1") == pytest.approx(600.0)
+
+    def test_unattributed_failure_stays_admissible(self, store):
+        store.register_host("node1", capacity=2)
+        store.record_failure("node1", rc=-15, attributed=False)
+        assert not store.is_quarantined("node1")
+        assert [h["host_id"] for h in store.admissible_hosts()] == ["node1"]
+
+    def test_quarantined_host_excluded_across_probes(self, store, clock):
+        """The acceptance invariant: a quarantined host is provably never
+        re-admitted before its backoff expires, however many healthy
+        probes it banks in the meantime."""
+        store.register_host("node0", capacity=2, node_rank=0)
+        store.register_host("node1", capacity=2, node_rank=1)
+        store.record_failure("node1", rc=139, attributed=True)
+        for _ in range(3):  # >= 2 grow probes while quarantined
+            clock.advance(5.0)
+            store.heartbeat("node0")
+            store.heartbeat("node1")
+            store.record_probe("node0", healthy=True)
+            store.record_probe("node1", healthy=True)
+            admitted = [
+                h["host_id"]
+                for h in store.admissible_hosts(min_healthy_probes=2)
+            ]
+            assert "node1" not in admitted
+        # probes banked DURING quarantine never count: the streak is
+        # pinned at zero until the backoff fully expires
+        assert store.health("node1")["consecutive_healthy_probes"] == 0
+        assert store.admissible_capacity() == 2
+        clock.advance(60.0)  # backoff expires
+        assert not store.is_quarantined("node1")
+        for _ in range(2):
+            store.heartbeat("node1")
+            store.record_probe("node1", healthy=True)
+        assert "node1" in [
+            h["host_id"]
+            for h in store.admissible_hosts(min_healthy_probes=2)
+        ]
+
+    def test_min_healthy_probes_gates_admission(self, store):
+        store.register_host("node0", capacity=2)
+        assert store.admissible_capacity(min_healthy_probes=2) == 0
+        store.record_probe("node0")
+        assert store.admissible_capacity(min_healthy_probes=2) == 0
+        store.record_probe("node0")
+        assert store.admissible_capacity(min_healthy_probes=2) == 2
+
+
+class TestGrowGate:
+    def test_needs_consecutive_probes(self):
+        clk = FakeClock()
+        g = GrowGate(probes_needed=3, min_interval_s=0.0, clock=clk)
+        assert not g.observe(4, 2)
+        assert not g.observe(4, 2)
+        assert g.observe(4, 2)
+
+    def test_capacity_dip_resets_streak(self):
+        clk = FakeClock()
+        g = GrowGate(probes_needed=2, min_interval_s=0.0, clock=clk)
+        assert not g.observe(4, 2)
+        assert not g.observe(2, 2)  # dip: capacity == world
+        assert g.streak == 0
+        assert not g.observe(4, 2)
+        assert g.observe(4, 2)
+
+    def test_min_interval_since_reshard(self):
+        clk = FakeClock()
+        g = GrowGate(probes_needed=1, min_interval_s=30.0, clock=clk)
+        g.note_reshard()
+        assert not g.observe(4, 2)  # hysteresis window still open
+        clk.advance(31.0)
+        assert g.observe(4, 2)
+
+    def test_veto_restarts_streak(self):
+        clk = FakeClock()
+        g = GrowGate(probes_needed=2, min_interval_s=0.0, clock=clk)
+        g.observe(4, 2)
+        g.observe(4, 2)
+        g.veto()
+        assert not g.observe(4, 2)
+        assert g.observe(4, 2)
+
+
+class TestTCPStore:
+    def test_roundtrip_over_tcp(self, tmp_path, clock):
+        backing = MembershipStore(str(tmp_path / "ms"), clock=clock)
+        server, _thread = serve_store(backing, port=0)
+        try:
+            host, port = server.server_address
+            client = open_store(f"tcp://{host}:{port}")
+            assert isinstance(client, TCPMembershipStore)
+            client.register_host(host_id="node1", capacity=4, node_rank=1)
+            client.heartbeat(host_id="node1")
+            assert [h["host_id"] for h in backing.hosts()] == ["node1"]
+            client.record_failure(host_id="node1", rc=-11, attributed=True)
+            assert client.is_quarantined(host_id="node1") is True
+            assert backing.is_quarantined("node1")
+            epoch = client.bump_epoch(world=2, mode="shrink", reason="t")
+            client.publish_generation(
+                epoch=epoch, world=2, assignments=[["node1", 2]],
+                port=5555, mode="shrink", attempt=1,
+            )
+            # client-side wait loop (wait_generation is not an RPC)
+            doc = client.wait_generation(min_epoch=epoch, timeout_s=2.0)
+            assert doc["world"] == 2
+            client.post_result(
+                epoch=epoch, host_id="node1", code=0, n_failed=0
+            )
+            assert backing.results(epoch)[0]["code"] == 0
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_server_error_propagates(self, tmp_path):
+        backing = MembershipStore(str(tmp_path / "ms"))
+        server, _thread = serve_store(backing, port=0)
+        try:
+            host, port = server.server_address
+            client = TCPMembershipStore(f"tcp://{host}:{port}")
+            with pytest.raises(RuntimeError, match="unregistered"):
+                client.heartbeat(host_id="ghost")
+            with pytest.raises(AttributeError):
+                client.not_a_method
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_bad_address_rejected(self):
+        with pytest.raises(ValueError):
+            TCPMembershipStore("tcp://no-port")
+
+    def test_open_store_dispatch(self, tmp_path):
+        assert isinstance(
+            open_store(str(tmp_path / "dir")), MembershipStore
+        )
+
+
+class TestAttribution:
+    @pytest.mark.parametrize("rc", [-11, -7, -4, -8, 139, 135, 132, 136])
+    def test_host_fault_signals_attribute(self, rc):
+        assert attributes_to_host(rc)
+
+    @pytest.mark.parametrize("rc", [None, -9, -15, 124, 137, 143])
+    def test_external_terminations_never_attribute(self, rc):
+        # a preempted host is innocent — it must stay admissible for
+        # grow-back, even with hardware-looking text in the tail
+        assert not attributes_to_host(rc, "uncorrectable ECC error")
+
+    def test_hardware_sentinel_text_attributes(self):
+        assert attributes_to_host(1, "HBM error on chip 3")
+        assert attributes_to_host(1, "Uncorrectable ECC fault")
+
+    def test_plain_crash_does_not_attribute(self):
+        assert not attributes_to_host(1)
+        assert not attributes_to_host(2, "usage: prog [-h]")
+
+
+class TestElasticFlapRule:
+    def _run(self):
+        from pytorch_distributedtraining_tpu.analyze.registry import (
+            AnalysisContext,
+            run_rules,
+        )
+
+        return run_rules(AnalysisContext(), planes=("runtime",))
+
+    def _seed(self, advances, window_s, limit):
+        reset_runtime_stats()
+        runtime_stats["epoch_advances"] = list(advances)
+        runtime_stats["hysteresis_window_s"] = window_s
+        runtime_stats["flap_limit"] = limit
+
+    def test_flapping_epochs_error(self):
+        from pytorch_distributedtraining_tpu.analyze.findings import (
+            Severity,
+        )
+
+        t0 = time.monotonic()
+        try:
+            # 5 epoch bumps within a 30s hysteresis window, limit 3
+            self._seed([t0 + i for i in range(5)], 30.0, 3)
+            report = self._run()
+            f = next(
+                f for f in report.findings if f.rule == "elastic-flap"
+            )
+            assert f.severity is Severity.ERROR
+            assert "worst_window=5" in f.evidence
+        finally:
+            reset_runtime_stats()
+
+    def test_spread_out_epochs_clean(self):
+        t0 = time.monotonic()
+        try:
+            # same 5 bumps, but spread far wider than the window
+            self._seed([t0 + 100 * i for i in range(5)], 30.0, 3)
+            report = self._run()
+            assert "elastic-flap" not in [
+                f.rule for f in report.findings
+            ]
+            # and silent entirely when the launcher never armed the knobs
+            self._seed([t0, t0 + 1], None, None)
+            report = self._run()
+            assert "elastic-flap" not in [
+                f.rule for f in report.findings
+            ]
+        finally:
+            reset_runtime_stats()
+
+
+def test_store_concurrent_writers(tmp_path):
+    """Two threads hammering the same store never tear a read (the
+    monitor-loop guarantee: readers may see old state, never garbage)."""
+    store = MembershipStore(str(tmp_path / "ms"), ttl_s=0)
+    store.register_host("node0", capacity=2)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            try:
+                store.heartbeat("node0")
+                store.record_probe("node0", healthy=bool(i % 2))
+                i += 1
+            except Exception as e:  # noqa: BLE001 — the assertion target
+                errors.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(2)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 1.0
+    while time.monotonic() < deadline:
+        doc = store.health("node0")
+        assert isinstance(doc["consecutive_healthy_probes"], int)
+        assert store.hosts() is not None
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors

@@ -637,10 +637,19 @@ class TestElasticLauncher:
     def test_elastic_flag_validation(self, tmp_path):
         script = tmp_path / "noop.py"
         script.write_text("")
-        for args in (
-            ["--nproc_per_node=2", "--elastic", str(script)],
-            ["--nproc_per_node=2", "--max_restarts=1", "--elastic",
-             "--min_world=3", str(script)],
+        for args, expect in (
+            (["--nproc_per_node=2", "--elastic", str(script)],
+             "--max_restarts"),
+            # --min_world is validated against the TOTAL elastic world:
+            # 3 > 1*2 rejects single-node...
+            (["--nproc_per_node=2", "--max_restarts=1", "--elastic",
+              "--min_world=3", str(script)], "--min_world"),
+            # ...and 5 > 2*2 rejects multi-node, with the computed total
+            # named in the error (not one node's nproc_per_node)
+            (["--nnodes=2", "--node_rank=0", "--master_port=29573",
+              "--nproc_per_node=2", "--max_restarts=1", "--elastic",
+              f"--membership-dir={tmp_path / 'ms'}", "--min_world=5",
+              str(script)], "nnodes*nproc_per_node=4"),
         ):
             proc = subprocess.run(
                 [
@@ -651,6 +660,30 @@ class TestElasticLauncher:
                 capture_output=True, text=True, timeout=60, cwd=REPO,
             )
             assert proc.returncode == 2, proc.stderr[-500:]
+            assert expect in proc.stderr, (expect, proc.stderr[-500:])
+
+    def test_stale_recovery_mode_env_never_inherited(self, tmp_path):
+        """A stale GRAFT_RECOVERY_MODE in the LAUNCHER's own environment
+        (a previous shrink's export, an outer launcher, a test harness)
+        must not leak into generation-0 children: a generation launched
+        without an explicit mode decision reports no mode at all."""
+        script = tmp_path / "mode.py"
+        script.write_text(ELASTIC_SCRIPT)
+        out = tmp_path / "out.txt"
+        env = dict(os.environ)
+        env.update(OUT=str(out), GRAFT_RECOVERY_MODE="shrink")
+        proc = subprocess.run(
+            [
+                sys.executable, "-m",
+                "pytorch_distributedtraining_tpu.runtime.launch",
+                "--nproc_per_node=1", str(script),
+            ],
+            env=env, capture_output=True, text=True, timeout=120, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert out.read_text().splitlines() == [
+            "attempt=0 rank=0 world=1 mode=-"
+        ]
 
 
 # -- bench recovery arm (end to end) ---------------------------------------
@@ -688,3 +721,245 @@ def test_bench_recovery_arm_end_to_end(tmp_path):
     ]
     assert rec["resume_step"] < min(torn_steps)
     assert rec["resume_step"] == rec["crash_step"] - 2
+
+
+# -- elastic grow-back + multi-node membership (ISSUE 11) -------------------
+
+
+@pytest.mark.slow
+def test_bench_grow_arm_end_to_end():
+    """Acceptance: the grow drill shrinks 2→1 on the preemption, the
+    controller's capacity probes fire the hysteresis gate, the world is
+    torn down gracefully (forced portable save) and relaunched at 2 with
+    GRAFT_RECOVERY_MODE=grow — and the grown state is BITWISE equal to an
+    independent single-device read of the same checkpoint. The bench
+    record publishes time_to_grow_s."""
+    env = dict(os.environ)
+    env["GRAFT_BENCH_RECOVERY"] = "1"
+    env["GRAFT_BENCH_RECOVERY_GROW"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-1000:])
+    rec = None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            rec = json.loads(line)
+            break
+    assert rec is not None, proc.stdout[-2000:]
+    if rec.get("skipped"):
+        pytest.skip(f"no multiprocess CPU world here: {rec.get('reason')}")
+    assert rec["metric"] == "time_to_recover_s"
+    assert rec["recovery_mode"] == "shrink"
+    assert rec["world_from"] == 2 and rec["world_to"] == 1
+    assert rec["time_to_grow_s"] > 0
+    assert rec["grow_world_to"] == 2 and rec["grow_mesh_to"] == 4
+    assert rec["grow_bitwise_ok"] is True
+    # the grow generation resumed at (or past) the shrink generation's
+    # resume point — a grow must never lose committed progress
+    assert rec["grow_resume_step"] >= rec["resume_step"]
+    assert rec["torn_dirs_skipped"], rec
+
+
+@pytest.mark.slow
+def test_kill_during_pre_grow_save_leaves_committed_checkpoint(tmp_path):
+    """Chaos: SIGKILL the trainer INSIDE its first attempt-1 checkpoint
+    write (which — depending on when the grow teardown lands — is either
+    the pre-grow forced save or the last scheduled save before it). The
+    torn .tmp must never become a resume source: whichever generation
+    comes next resumes from the last COMMITTED step, and the run still
+    grows back to the full world with a bitwise-clean reshard."""
+    from pytorch_distributedtraining_tpu.runtime import recovery_drill
+
+    out = tmp_path / "events.jsonl"
+    crash_step = 4
+    plan = {
+        "faults": [
+            {"site": "ckpt.write", "action": "sleep", "arg": 600,
+             "rank": 0, "attempt": 0, "match": {"step": crash_step - 1}},
+            {"site": "train.preempt", "action": "kill",
+             "rank": 0, "attempt": 0, "match": {"step": crash_step}},
+            # the new rule under test: the shrunken generation's FIRST
+            # save dies mid-write, leaving a second torn .tmp behind
+            {"site": "ckpt.write", "action": "kill",
+             "rank": 0, "attempt": 1, "at": 1},
+        ]
+    }
+    plan_path = tmp_path / "fault_plan.json"
+    plan_path.write_text(json.dumps(plan))
+    env = dict(os.environ)
+    env.update(
+        GRAFT_FAULT_PLAN=str(plan_path),
+        GRAFT_DRILL_OUT=str(out),
+        GRAFT_DRILL_CKPT=str(tmp_path / "ckpt"),
+        GRAFT_DRILL_STEPS=str(crash_step + 12),
+        GRAFT_DRILL_GROW="1",
+        GRAFT_DRILL_STEP_SLEEP_S="0.25",
+        GRAFT_GROW_PROBES="2",
+        GRAFT_GROW_PROBE_INTERVAL_S="0.3",
+        GRAFT_GROW_MIN_INTERVAL_S="3",
+        GRAFT_LAUNCH_ESCALATE_S="5",
+        GRAFT_RESTART_BACKOFF="0.1",
+        JAX_PLATFORMS="cpu",
+        PYTHONUNBUFFERED="1",
+    )
+    if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4"
+        ).strip()
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "pytorch_distributedtraining_tpu.runtime.launch",
+            "--nproc_per_node=2", "--max_restarts=2",
+            "--elastic", "--grow", "--min_world=1",
+            recovery_drill.__file__,
+        ],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    events = [json.loads(l) for l in out.read_text().splitlines() if l.strip()]
+    if any(e["event"] == "skip" for e in events):
+        pytest.skip("no multiprocess CPU world here")
+    # some generation saw the torn attempt-1 write and still resumed from
+    # the last committed step BELOW it (step 2: steps 1,2 committed in
+    # gen 0; step 3's writes were torn in both gen 0 and gen 1)
+    resumes = [e for e in events if e["event"] == "resume"]
+    torn_resume = next(
+        e for e in resumes
+        if any("0000000003" in d for d in e["torn_dirs"])
+    )
+    assert torn_resume["step"] == 2
+    # and the run still grew back to the full world, bitwise-clean
+    grow_resume = next(e for e in resumes if e["mode"] == "grow")
+    assert grow_resume["world"] == 2 and grow_resume["fsdp"] == 4
+    bit = next(e for e in events if e["event"] == "grow_bitwise")
+    assert bit["ok"] is True
+    assert events[-1]["event"] == "done"
+
+
+MULTINODE_SCRIPT = textwrap.dedent("""
+    import os, signal, sys, time
+    attempt = int(os.environ.get("GRAFT_RESTART_ATTEMPT", "0"))
+    node = os.environ.get("GRAFT_NODE_RANK", "?")
+    rank = os.environ.get("RANK", "?")
+    world = os.environ.get("WORLD_SIZE", "?")
+    mode = os.environ.get("GRAFT_RECOVERY_MODE", "-")
+    with open(os.environ["OUT"], "a") as fh:
+        fh.write(f"attempt={attempt} node={node} rank={rank} "
+                 f"world={world} mode={mode}\\n")
+    if node == "1" and attempt == 0:
+        time.sleep(0.4)
+        os.kill(os.getpid(), signal.SIGSEGV)  # the HOST's fault
+    time.sleep(2.5 if attempt else 25)
+""")
+
+
+def _launch_node(node_rank, script, tmp_path, extra_env, port):
+    env = dict(os.environ)
+    env.update(
+        OUT=str(tmp_path / "out.txt"),
+        GRAFT_RESTART_BACKOFF="0.05",
+        GRAFT_LAUNCH_ESCALATE_S="3",
+        GRAFT_MEMBERSHIP_RESULT_GRACE_S="10",
+        GRAFT_MEMBERSHIP_GEN_TIMEOUT_S="60",
+        **extra_env,
+    )
+    return subprocess.Popen(
+        [
+            sys.executable, "-m",
+            "pytorch_distributedtraining_tpu.runtime.launch",
+            "--nnodes=2", f"--node_rank={node_rank}",
+            "--master_addr=127.0.0.1", f"--master_port={port}",
+            "--nproc_per_node=1", "--max_restarts=2",
+            "--elastic", "--grow", "--min_world=1",
+            f"--membership-dir={tmp_path / 'ms'}",
+            str(script),
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO,
+    )
+
+
+@pytest.mark.slow
+def test_multinode_quarantine_excludes_host_across_grow_probes(tmp_path):
+    """Two launchers share one membership store. Node 1's rank SIGSEGVs —
+    a host-attributed fault — so the controller quarantines node1, shrinks
+    the world onto node0, and across every subsequent grow probe node1
+    stays excluded: it is never re-admitted before its backoff expires."""
+    script = tmp_path / "work.py"
+    script.write_text(MULTINODE_SCRIPT)
+    extra = {
+        "GRAFT_QUARANTINE_BASE_S": "120",
+        "GRAFT_GROW_PROBES": "2",
+        "GRAFT_GROW_PROBE_INTERVAL_S": "0.3",
+        "GRAFT_GROW_MIN_INTERVAL_S": "5",
+    }
+    p0 = _launch_node(0, script, tmp_path, extra, port=29571)
+    p1 = _launch_node(1, script, tmp_path, extra, port=29571)
+    try:
+        out0 = p0.communicate(timeout=120)
+        out1 = p1.communicate(timeout=120)
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                p.kill()
+    assert p0.returncode == 0, out0[1][-3000:]
+    # node1's launcher exits 0 too: shrunk out, it idled until the
+    # controller published the terminal generation
+    assert p1.returncode == 0, out1[1][-3000:]
+    assert "elastic: shrinking world 2 -> 1" in out0[1]
+    assert "membership: quarantine host=node1" in out0[1]
+    lines = (tmp_path / "out.txt").read_text().splitlines()
+    # the quarantined host never ran a rank again after generation 0
+    assert [l for l in lines if "node=1" in l and "attempt=0" not in l] == []
+    assert "attempt=1 node=0 rank=0 world=1 mode=shrink" in lines
+    # ...and was excluded from >= 2 capacity probes while quarantined
+    trans = [
+        json.loads(l)
+        for l in (tmp_path / "ms" / "transitions.jsonl").read_text().splitlines()
+    ]
+    probes = [
+        t for t in trans
+        if t["kind"] == "grow_probe" and "node1" in t["excluded"]
+    ]
+    assert len(probes) >= 2, trans
+    quarantines = [t for t in trans if t["kind"] == "quarantine"]
+    assert [q["host"] for q in quarantines] == ["node1"]
+    assert quarantines[0]["rc"] == -11
+
+
+@pytest.mark.slow
+def test_multinode_min_world_above_one_node_accepted(tmp_path):
+    """--min_world may legitimately exceed one node's nproc_per_node (the
+    floor is on the TOTAL world): 3 ranks over 2 nodes x 2 procs parses
+    and launches. Only node 0 runs here — its local share exits 0, so the
+    controller publishes the terminal generation and returns 0."""
+    script = tmp_path / "ok.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        with open(os.environ["OUT"], "a") as fh:
+            fh.write(f"rank={os.environ['RANK']} "
+                     f"world={os.environ['WORLD_SIZE']}\\n")
+    """))
+    env = dict(os.environ)
+    env.update(OUT=str(tmp_path / "out.txt"))
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "pytorch_distributedtraining_tpu.runtime.launch",
+            "--nnodes=2", "--node_rank=0",
+            "--master_addr=127.0.0.1", "--master_port=29572",
+            "--nproc_per_node=2", "--max_restarts=1",
+            "--elastic", "--min_world=3",
+            f"--membership-dir={tmp_path / 'ms'}",
+            str(script),
+        ],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = sorted((tmp_path / "out.txt").read_text().splitlines())
+    assert lines == ["rank=0 world=4", "rank=1 world=4"]
