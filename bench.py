@@ -362,13 +362,49 @@ def _is_headline_config() -> bool:
     )
 
 
+def _regression_sentry(rec: dict) -> dict | None:
+    """Publication-time perf-regression check (observe/fleet.py).
+
+    Best-effort and lazily imported: the sentry compares this record
+    against the BENCH_r*/BENCH_LAST_GOOD trajectory with robust
+    median/MAD thresholds. Its verdict rides in the record (and gates
+    the last-good refresh below); any failure to run it must never
+    block publication.
+    """
+    try:
+        from pytorch_distributedtraining_tpu.observe import fleet
+
+        return fleet.regression_verdict(
+            rec, fleet.load_trajectory(os.path.dirname(_LAST_GOOD_PATH))
+        )
+    except Exception:
+        return None
+
+
 def _emit_result(line: str) -> None:
     global _DONE
     if _DONE:
         return
     _DONE = True
+    verdict = None
+    try:
+        rec = json.loads(line)
+        verdict = _regression_sentry(rec)
+        if verdict is not None:
+            rec["regression"] = verdict
+            line = json.dumps(rec)
+            if verdict["status"] in ("drift", "regression"):
+                _status(
+                    f"regression sentry: {verdict.get('detail', verdict['status'])}"
+                )
+    except Exception:
+        pass
     try:  # best-effort: remember the measurement for outage error records
-        if _is_headline_config():
+        # a regressed record must NOT become the new last-good baseline —
+        # that would ratchet the trajectory down and blind the sentry
+        if _is_headline_config() and (
+            verdict is None or verdict["status"] != "regression"
+        ):
             rec = json.loads(line)
             rec["measured_at"] = time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -1618,6 +1654,7 @@ def _bench() -> None:
     goodput_fraction = None
     time_breakdown = None
     telemetry_overhead_fraction = None
+    fleet_summary = None
     if telemetry.enabled():
         from pytorch_distributedtraining_tpu.observe.goodput import (
             GoodputLedger,
@@ -1702,6 +1739,17 @@ def _bench() -> None:
                 )
             except Exception as e:  # noqa: BLE001
                 print(f"# child: trace export failed: {e}", flush=True)
+        # fleet-plane step-time histogram (observe/fleet.py): built
+        # post-hoc from the already-recorded span buffer, so it costs the
+        # hot path nothing and the 1% overhead gate below is unaffected
+        try:
+            from pytorch_distributedtraining_tpu.observe.fleet import (
+                fleet_summary_from_records,
+            )
+
+            fleet_summary = fleet_summary_from_records(telemetry.records())
+        except Exception as e:  # noqa: BLE001 — accounting, not the metric
+            print(f"# child: fleet summary unavailable: {e}", flush=True)
         if telemetry_overhead_fraction > 0.01:
             # no "# " prefix: _informative_tail must pick THIS line as
             # the cause in the parent's error record
@@ -1923,6 +1971,7 @@ def _bench() -> None:
                 "goodput_fraction": goodput_fraction,
                 "time_breakdown": time_breakdown,
                 "telemetry_overhead_fraction": telemetry_overhead_fraction,
+                "fleet": fleet_summary,
                 "compile_cache": compile_cache,
                 "static_findings": static_findings,
                 "peak_hbm_bytes": peak_hbm_bytes,

@@ -48,6 +48,9 @@ STAGES = [
              "grow back to 2 with a bitwise reshard check — "
              "time_to_grow_s (bench.py, GRAFT_BENCH_RECOVERY=1 "
              "GRAFT_BENCH_RECOVERY_GROW=1)"),
+    ("fleet", "fleet observability: merged cross-host trace rollup "
+              "(trace_summary.py per-host lanes) + perf-regression "
+              "sentry vs the BENCH_* trajectory (regress.py)"),
     ("dispatch_probe", "tunnel dispatch-cost decomposition (dispatch_probe.py)"),
     ("bench_scan_k10", "bench.py, fused + lax.scan k=10 per dispatch"),
     ("bench_scan_k25", "bench.py, fused + lax.scan k=25 per dispatch"),

@@ -1,0 +1,84 @@
+"""Perf-regression sentry: a fresh bench record vs the BENCH_* trajectory.
+
+The repo's perf history already lives in the checkout — ``BENCH_r*.json``
+round wrappers plus ``BENCH_LAST_GOOD.json`` — but until now nothing read
+it back. This CLI closes the loop: given a fresh record (a file, stdin,
+or the newest round's ``parsed`` field), it compares the value against
+the trajectory of *genuine* measurements for the same metric family
+using robust median/MAD thresholds (``observe/fleet.py:
+regression_verdict``), so one noisy historical sample can't move the
+baseline and a pool-outage record can't fake a regression.
+
+    python benchmarks/regress.py                       # newest round vs history
+    python benchmarks/regress.py fresh.json            # explicit record
+    some_bench | python benchmarks/regress.py -        # record on stdin
+
+Exit codes (CI-friendly): 0 = ok / improved / excluded / no-trajectory,
+1 = drift (WARN: beyond the noise band and the warn threshold),
+2 = regression (ERROR: beyond the error threshold). Outage and fallback
+records — ``"error"`` keys, ``provenance: FALLBACK``, ``measured:
+false``, zero values — are excluded on BOTH sides: they never enter the
+baseline statistics and a fresh one is never itself a verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+from pytorch_distributedtraining_tpu.observe import fleet
+
+_EXIT = {"drift": 1, "regression": 2}
+
+
+def _load_fresh(spec: str | None, root: str):
+    if spec == "-":
+        return json.load(sys.stdin)
+    if spec:
+        with open(spec, encoding="utf-8") as fh:
+            return json.load(fh)
+    # default: the newest record in the trajectory IS the fresh one —
+    # compare it against everything that came before it
+    history = fleet.load_trajectory(root)
+    if not history:
+        raise SystemExit(f"no BENCH_*.json trajectory under {root}")
+    return history[-1]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "record", nargs="?", default=None,
+        help="fresh bench record JSON (file path, or '-' for stdin); "
+        "default: the newest trajectory record vs everything before it",
+    )
+    ap.add_argument(
+        "--root", default=_bootstrap._ROOT,
+        help="directory holding BENCH_r*.json / BENCH_LAST_GOOD.json "
+        "(default: the repo root)",
+    )
+    ap.add_argument("--warn-frac", type=float, default=0.05,
+                    help="drift (WARN) threshold as a fraction of the "
+                    "baseline median (default 0.05)")
+    ap.add_argument("--err-frac", type=float, default=0.15,
+                    help="regression (ERROR) threshold (default 0.15)")
+    opt = ap.parse_args(argv)
+
+    fresh = _load_fresh(opt.record, opt.root)
+    history = fleet.load_trajectory(opt.root)
+    if opt.record is None and history:
+        # the implicit fresh record is history's tail; don't let a value
+        # vote for its own baseline
+        history = history[:-1]
+    verdict = fleet.regression_verdict(
+        fresh, history, warn_frac=opt.warn_frac, err_frac=opt.err_frac,
+    )
+    print(json.dumps(verdict))
+    return _EXIT.get(verdict["status"], 0)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

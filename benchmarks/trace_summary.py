@@ -198,6 +198,29 @@ def main(argv=None):
             "total_span_ms": round(total / 1e3, 3),
             "n_events": len(tel_events),
         }))
+        # merged fleet trace (observe/fleet.py merge_traces): several
+        # telemetry lanes in one file — a per-host/per-rank row each, so
+        # "which lane owns the time" is answerable before the combined
+        # rollup flattens them
+        if len(tel_pids) > 1:
+            for pid in sorted(tel_pids, key=lambda p: lanes[p]):
+                lane_events = [e for e in tel_events if e.get("pid") == pid]
+                by_cat = collections.Counter()
+                for e in lane_events:
+                    if e.get("ph") == "X":
+                        by_cat[e.get("cat", "other")] += e.get("dur", 0.0)
+                lane_total = sum(by_cat.values())
+                print(json.dumps({
+                    "lane": lanes[pid],
+                    "total_span_ms": round(lane_total / 1e3, 3),
+                    "n_events": sum(
+                        1 for e in lane_events if e.get("ph") in ("X", "i")
+                    ),
+                    "by_cat_ms": {
+                        c: round(v / 1e3, 3)
+                        for c, v in by_cat.most_common()
+                    },
+                }))
         for r in rows:
             print(json.dumps(r))
     if not tel_events or any(e.get("ph") == "X" for e in op_events):

@@ -199,3 +199,45 @@ def serve_recompile_under_load(ctx):
             f"steady_recompiles={grew}"
         ),
     )
+
+
+@rule(
+    "bench-regression",
+    "runtime",
+    "a fresh bench record regressed against the BENCH_* trajectory",
+)
+def bench_regression(ctx):
+    # sys.modules, never imported: observe.fleet is stdlib-only but its
+    # package __init__ pulls jax — the sentry (benchmarks/regress.py or
+    # bench.py's publication hook) populates runtime_stats before this
+    # plane runs
+    fl = sys.modules.get("pytorch_distributedtraining_tpu.observe.fleet")
+    stats = getattr(fl, "runtime_stats", None)
+    if not stats:
+        return
+    for v in stats.get("verdicts") or []:
+        status = v.get("status")
+        if status not in ("drift", "regression"):
+            continue
+        sev = Severity.ERROR if status == "regression" else Severity.WARN
+        yield Finding(
+            "bench-regression",
+            sev,
+            "runtime:bench",
+            (
+                f"bench metric {v.get('metric')!r} {status}: "
+                f"{v.get('detail', 'worse than the trajectory baseline')}. "
+                "Outage/fallback records are already excluded from the "
+                "baseline, so this is a genuine same-code slowdown — "
+                "bisect the change, or re-measure before refreshing "
+                "BENCH_LAST_GOOD.json (the sentry will not refresh it "
+                "over a regression)"
+            ),
+            evidence=(
+                f"value={v.get('value')} "
+                f"baseline_median={v.get('baseline_median')} "
+                f"n_history={v.get('n_history')} "
+                f"worse_frac={v.get('worse_frac')} "
+                f"noise_frac={v.get('noise_frac')}"
+            ),
+        )

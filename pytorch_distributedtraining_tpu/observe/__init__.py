@@ -43,6 +43,22 @@ from .goodput import (
     read_step_logs,
     straggler_check,
 )
+from . import fleet  # the fleet aggregation plane (observe.fleet)
+from .fleet import (
+    ClockOffset,
+    FleetMonitor,
+    MetricsExporter,
+    RankMetricsPublisher,
+    StreamHist,
+    estimate_offset,
+    estimate_store_offset,
+    lane_ledgers,
+    load_trajectory,
+    merge_ledgers,
+    merge_traces,
+    per_host_mfu,
+    regression_verdict,
+)
 from .sink import JSONLSink, MetricsSink, NullSink, WandbSink, make_sink
 from .profiling import StepTimer, TransferOverlapProbe
 from .profiling import trace as profiler_trace
@@ -101,4 +117,18 @@ __all__ = [
     "compiled_memory_stats",
     "device_hbm_budget",
     "tune_batch_size",
+    "fleet",
+    "StreamHist",
+    "ClockOffset",
+    "estimate_offset",
+    "estimate_store_offset",
+    "merge_traces",
+    "lane_ledgers",
+    "merge_ledgers",
+    "per_host_mfu",
+    "MetricsExporter",
+    "RankMetricsPublisher",
+    "FleetMonitor",
+    "load_trajectory",
+    "regression_verdict",
 ]

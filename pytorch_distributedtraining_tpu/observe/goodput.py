@@ -346,10 +346,28 @@ def mfu(
 # -- cross-process straggler detection ---------------------------------
 
 
-def step_log_dir(base: str | None = None) -> str:
+def _log_epoch(epoch: int | None = None) -> int:
+    """Generation epoch namespace for step logs: explicit arg wins, then
+    ``GRAFT_GEN_EPOCH`` (exported per generation by the elastic
+    launcher), else 0 (flat legacy layout)."""
+    if epoch is not None:
+        return int(epoch)
+    try:
+        return int(os.environ.get("GRAFT_GEN_EPOCH", "0"))
+    except ValueError:
+        return 0
+
+
+def step_log_dir(base: str | None = None, epoch: int | None = None) -> str:
     from . import trace as _trace
 
     d = os.path.join(base or _trace.run_dir(), "steps")
+    e = _log_epoch(epoch)
+    if e > 0:
+        # namespaced per generation: after an elastic shrink the new
+        # world's straggler statistics must not be polluted by stale
+        # logs from ranks of the larger world that no longer exist
+        d = os.path.join(d, f"epoch_{e}")
     os.makedirs(d, exist_ok=True)
     return d
 
@@ -362,12 +380,12 @@ class StepLog:
     """
 
     def __init__(self, rank: int | None = None, base: str | None = None,
-                 flush_every: int = 16):
+                 flush_every: int = 16, epoch: int | None = None):
         from . import trace as _trace
 
         self.rank = _trace._rank() if rank is None else int(rank)
         self.path = os.path.join(
-            step_log_dir(base), f"rank_{self.rank}.jsonl"
+            step_log_dir(base, epoch), f"rank_{self.rank}.jsonl"
         )
         self.flush_every = max(1, int(flush_every))
         self._pending: list = []
@@ -399,10 +417,26 @@ class StepLog:
         return False
 
 
-def read_step_logs(base: str | None = None) -> dict:
-    """``{rank: [dt_s, ...]}`` from every rank's step log (rank 0 calls
-    this; unreadable lines are skipped)."""
-    d = step_log_dir(base)
+def read_step_logs(
+    base: str | None = None,
+    epoch: int | None = None,
+    stats: dict | None = None,
+) -> dict:
+    """``{rank: [dt_s, ...]}`` from every rank's step log (rank 0 and the
+    fleet monitor call this).
+
+    A rank killed mid-write — elastic shrink, preemption, fault drill —
+    leaves a torn trailing line (no newline, possibly split inside a
+    UTF-8 sequence). The reader must tolerate it: the partial record is
+    skipped, never raised, and counted in ``stats`` (pass a dict to
+    receive ``files`` / ``lines`` / ``skipped_lines`` /
+    ``torn_tail_lines``) so the monitor can report torn tails instead of
+    silently eating them.
+    """
+    d = step_log_dir(base, epoch)
+    counters = {
+        "files": 0, "lines": 0, "skipped_lines": 0, "torn_tail_lines": 0,
+    }
     out: dict = {}
     for name in sorted(os.listdir(d)):
         if not (name.startswith("rank_") and name.endswith(".jsonl")):
@@ -411,19 +445,31 @@ def read_step_logs(base: str | None = None) -> dict:
             rank = int(name[len("rank_"):-len(".jsonl")])
         except ValueError:
             continue
-        times: list = []
         try:
-            with open(os.path.join(d, name), encoding="utf-8") as fh:
-                for line in fh:
-                    try:
-                        times.append(float(json.loads(line)["dt_s"]))
-                    except (json.JSONDecodeError, KeyError, TypeError,
-                            ValueError):
-                        continue
+            with open(os.path.join(d, name), "rb") as fh:
+                raw = fh.read()
         except OSError:
             continue
+        counters["files"] += 1
+        torn_tail = bool(raw) and not raw.endswith(b"\n")
+        lines = raw.split(b"\n")
+        if lines and lines[-1] == b"":
+            lines.pop()
+        times: list = []
+        for i, line in enumerate(lines):
+            counters["lines"] += 1
+            try:
+                times.append(
+                    float(json.loads(line.decode("utf-8", "replace"))["dt_s"])
+                )
+            except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+                counters["skipped_lines"] += 1
+                if torn_tail and i == len(lines) - 1:
+                    counters["torn_tail_lines"] += 1
         if times:
             out[rank] = times
+    if stats is not None:
+        stats.update(counters)
     return out
 
 
@@ -502,7 +548,9 @@ def flag_stragglers(
     return StragglerReport(medians, zscores, stragglers, z_threshold)
 
 
-def straggler_check(base: str | None = None,
-                    z_threshold: float = 3.5) -> StragglerReport:
+def straggler_check(base: str | None = None, z_threshold: float = 3.5,
+                    epoch: int | None = None) -> StragglerReport:
     """Rank-0 entry point: aggregate every rank's step log and flag."""
-    return flag_stragglers(read_step_logs(base), z_threshold=z_threshold)
+    return flag_stragglers(
+        read_step_logs(base, epoch), z_threshold=z_threshold
+    )

@@ -44,6 +44,7 @@ import collections
 import functools
 import json
 import os
+import socket
 import sys
 import threading
 import time
@@ -113,6 +114,24 @@ def _rank() -> int:
             except ValueError:
                 pass
     return 0
+
+
+def _host() -> str:
+    """Best-effort host identity, matching the launcher's membership ids:
+    ``GRAFT_HOST_ID`` explicit, else ``node<GRAFT_NODE_RANK>`` (what
+    ``dist.initialize`` writes into the membership store), else the
+    hostname — so a merged fleet trace's lanes line up with the
+    membership store's health/quarantine records by name."""
+    explicit = os.environ.get("GRAFT_HOST_ID")
+    if explicit:
+        return explicit
+    node = os.environ.get("GRAFT_NODE_RANK")
+    if node is not None:
+        return f"node{node}"
+    try:
+        return socket.gethostname() or "host?"
+    except OSError:
+        return "host?"
 
 
 class Tracer:
@@ -211,9 +230,15 @@ class Tracer:
         """
         recs = self.records()
         pid = os.getpid()
+        # host + rank ride in the process metadata so merged fleet traces
+        # (observe/fleet.py) can lane by identity instead of colliding on
+        # whatever pids two hosts happened to hand out
         events = [{
             "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
-            "args": {"name": f"{process_name} (rank {_rank()})"},
+            "args": {
+                "name": f"{process_name} (rank {_rank()})",
+                "host": _host(), "rank": _rank(),
+            },
         }]
         if not recs:
             return events
@@ -239,15 +264,34 @@ class Tracer:
             else:
                 ev["ph"] = "X"
                 ev["dur"] = round(r["dur"] * 1e6, 3)
+                # nesting depth survives the export (viewers ignore the
+                # unknown key) so fleet.lane_ledgers can rebuild the
+                # top-level-only goodput billing from a merged trace
+                ev["depth"] = int(r.get("depth", 0))
             events.append(ev)
         return events
 
     def export_chrome_trace(self, path: str) -> str:
-        """Write the buffer as a Chrome trace-event JSON file."""
+        """Write the buffer as a Chrome trace-event JSON file.
+
+        ``graftMeta`` anchors the trace for the fleet merge: record
+        timestamps are perf_counter-based and re-zeroed, so ``wall_t0``
+        stamps what this host's wall clock read at the trace's zero —
+        the hook the clock-offset re-basing needs.
+        """
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        recs = self.records()
+        base = min((r["t0"] for r in recs), default=time.perf_counter())
+        wall_t0 = time.time() - (time.perf_counter() - base)
         with open(path, "w", encoding="utf-8") as fh:
-            json.dump({"traceEvents": self.chrome_events(),
-                       "displayTimeUnit": "ms"}, fh)
+            json.dump({
+                "traceEvents": self.chrome_events(),
+                "displayTimeUnit": "ms",
+                "graftMeta": {
+                    "host": _host(), "rank": _rank(), "pid": os.getpid(),
+                    "wall_t0": wall_t0,
+                },
+            }, fh)
         return path
 
 

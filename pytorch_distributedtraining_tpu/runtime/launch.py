@@ -40,6 +40,7 @@ from __future__ import annotations
 import argparse
 import multiprocessing
 import os
+import shutil
 import subprocess
 import sys
 import time
@@ -259,6 +260,7 @@ def _run_world(
     port: int,
     extra_env: dict | None = None,
     ctl: _MembershipCtl | None = None,
+    monitor=None,
 ) -> tuple[int, int, list, str]:
     """Launch one generation of this node's share of the world.
 
@@ -428,6 +430,14 @@ def _run_world(
                     if q.poll() is None:
                         signalled.add(q.pid)
                         q.kill()
+            if monitor is not None:
+                # fleet observability rides the same cadence as the
+                # heartbeats: rate-limited inside poll(), and a broken
+                # scrape path must never take the world down
+                try:
+                    monitor.poll()
+                except Exception:  # noqa: BLE001
+                    pass
             time.sleep(0.1)
     finally:
         for q in procs:
@@ -512,6 +522,41 @@ def _report_flight_records(run_dir: str) -> None:
             pass
 
 
+def _gc_stale_step_logs(run_dir: str, keep_epoch: int) -> None:
+    """Run-dir hygiene between generations.
+
+    Step logs are epoch-namespaced (``steps/epoch_<E>/rank_N.jsonl``,
+    observe/goodput.py) so a shrunken world's straggler statistics are
+    never computed over stale files from ranks of a larger world that no
+    longer exist. This drops every namespace older than the generation
+    about to launch — and, once epochs are in use, the flat legacy
+    layout too (it can only be a previous generation's leftovers).
+    """
+    steps = os.path.join(run_dir, "steps")
+    try:
+        names = os.listdir(steps)
+    except OSError:
+        return
+    for name in names:
+        path = os.path.join(steps, name)
+        try:
+            if name.startswith("epoch_"):
+                try:
+                    epoch = int(name[len("epoch_"):])
+                except ValueError:
+                    continue
+                if epoch < keep_epoch:
+                    shutil.rmtree(path, ignore_errors=True)
+            elif (
+                keep_epoch > 0
+                and name.startswith("rank_")
+                and name.endswith(".jsonl")
+            ):
+                os.remove(path)
+        except OSError:
+            continue
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="TPU-native torch.distributed.launch twin"
@@ -563,6 +608,15 @@ def main(argv=None) -> int:
         "--serve_membership", type=int, default=None, metavar="PORT",
         help="serve this launcher's file-backed membership store over TCP "
         "on PORT (0 = ephemeral) for nodes without a shared filesystem",
+    )
+    parser.add_argument(
+        "--observe", type=int, default=None, metavar="PORT",
+        help="serve live fleet metrics (Prometheus text exposition: step-"
+        "time histograms merged across ranks, straggler gauge) on "
+        "127.0.0.1:PORT (0 = ephemeral) and continuously re-run the "
+        "straggler check against the run dir's step logs; with a "
+        "membership store, flagged stragglers also reset their host's "
+        "healthy-probe streak (the quarantine/grow admission signal)",
     )
     parser.add_argument(
         "--min_world", "--min-world", type=int, default=1, dest="min_world",
@@ -658,6 +712,23 @@ def main(argv=None) -> int:
         if not location.startswith("tcp://"):
             os.environ.setdefault("GRAFT_MEMBERSHIP", location)
 
+    # -- fleet observability plane (observe/fleet.py) -----------------------
+    # imported lazily: the flag is opt-in and the launcher otherwise never
+    # pulls the observe package. Like the membership TCP server above, the
+    # exporter is daemon-threaded and dies with the launcher.
+    monitor = None
+    if opt.observe is not None:
+        from ..observe import fleet as _fleet
+
+        monitor = _fleet.FleetMonitor(
+            run_dir, store=ctl.store if ctl is not None else None,
+            port=opt.observe,
+        )
+        print(
+            f"[launch] fleet metrics on {monitor.exporter.url}",
+            file=sys.stderr, flush=True,
+        )
+
     assignments = [
         [f"node{i}", opt.nproc_per_node] for i in range(opt.nnodes)
     ]
@@ -729,10 +800,22 @@ def main(argv=None) -> int:
             # may linger in TIME_WAIT after a crash — honor a pinned
             # --master_port only for the first generation
             gen_port = find_free_port()
-        extra = {"GRAFT_RECOVERY_MODE": mode} if mode else None
+        # generation epoch namespaces the step logs (and tells the fleet
+        # monitor which namespace is current): the membership epoch when a
+        # store coordinates the fleet, else the local generation counter
+        log_epoch = ctl.epoch if ctl is not None else gen
+        _gc_stale_step_logs(run_dir, log_epoch)
+        if monitor is not None:
+            monitor.note_epoch(log_epoch)
+        extra = {
+            "GRAFT_GEN_EPOCH": str(log_epoch),
+            "GRAFT_HOST_ID": host_id,
+        }
+        if mode:
+            extra["GRAFT_RECOVERY_MODE"] = mode
         code, n_failed, rcs, outcome = _run_world(
             opt, gen, nproc, rank_base, world, gen_port,
-            extra_env=extra, ctl=ctl,
+            extra_env=extra, ctl=ctl, monitor=monitor,
         )
         if ctl is not None:
             try:

@@ -160,7 +160,7 @@ class MembershipStore:
             else os.environ.get("GRAFT_QUARANTINE_MAX_S", "3600")
         )
         self._clock = clock
-        for sub in ("hosts", "health", "ranks", "results"):
+        for sub in ("hosts", "health", "ranks", "results", "metrics"):
             os.makedirs(os.path.join(self.root, sub), exist_ok=True)
 
     # -- paths -------------------------------------------------------------
@@ -504,6 +504,47 @@ class MembershipStore:
             return doc
         return None
 
+    # -- fleet metrics -------------------------------------------------------
+
+    def clock_probe(self) -> dict:
+        """One timestamp off this store's clock — the remote half of the
+        fleet plane's midpoint offset estimator (``observe.fleet.
+        estimate_store_offset``). Over the TCP proxy the request/response
+        pair rides the same line-JSON protocol as every other call, so
+        the estimator's RTT bound *is* the protocol's round trip."""
+        return {"t": self._clock(), "pid": os.getpid()}
+
+    def publish_metrics(self, host_id: str, rank: int, doc: dict) -> None:
+        """One rank's current metric snapshot (mergeable histograms, see
+        ``observe.fleet.StreamHist``) — last write wins per rank; the
+        controller's FleetMonitor folds all of them per refresh."""
+        path = os.path.join(self.root, "metrics", f"rank_{int(rank)}.json")
+        _write_json_atomic(path, {
+            "host_id": host_id,
+            "rank": int(rank),
+            "t": self._clock(),
+            **(doc or {}),
+        })
+
+    def read_metrics(self, alive_within_s: float | None = None) -> list[dict]:
+        """Every rank's latest published snapshot, stale ones dropped."""
+        ttl = self.ttl_s if alive_within_s is None else float(alive_within_s)
+        now = self._clock()
+        out = []
+        metrics_dir = os.path.join(self.root, "metrics")
+        try:
+            names = sorted(os.listdir(metrics_dir))
+        except OSError:
+            return []
+        for name in names:
+            doc = _read_json(os.path.join(metrics_dir, name))
+            if doc is None:
+                continue
+            if ttl > 0 and now - doc.get("t", 0.0) > ttl:
+                continue
+            out.append(doc)
+        return out
+
     # -- transitions ---------------------------------------------------------
 
     def record_transition(self, kind: str, **detail) -> None:
@@ -611,6 +652,7 @@ _RPC_METHODS = frozenset({
     "post_result", "results",
     "request_teardown", "teardown_requested",
     "record_transition", "transitions",
+    "clock_probe", "publish_metrics", "read_metrics",
 })
 
 

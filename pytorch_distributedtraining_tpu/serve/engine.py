@@ -65,6 +65,26 @@ runtime_stats = {
     "jit_entries_now": 0,
 }
 
+# Rolling serve-latency histograms for the fleet metrics plane: every
+# delivery feeds them, and observe/fleet.py's RankMetricsPublisher reads
+# this dict via sys.modules (it must stay stdlib-importable and cannot
+# import this jax-loaded module). StreamHist bounds are fixed, so the
+# controller merges one rank's TTFT histogram with another's by count sum.
+rolling_hists: dict = {}
+
+
+def note_delivery(rec: dict) -> None:
+    from ..observe.fleet import StreamHist
+
+    for name, key in (
+        ("serve_latency_seconds", "latency_s"),
+        ("serve_ttft_seconds", "ttft_s"),
+    ):
+        v = rec.get(key)
+        if v is None:
+            continue
+        rolling_hists.setdefault(name, StreamHist()).observe(float(v))
+
 
 class ServeEngine:
     """Continuous-batching engine for GPT-2 decode.
@@ -343,7 +363,9 @@ class ServeEngine:
             self.sched.retire(st, now)
             self._page_table[st.slot] = 0
             self._lengths[st.slot] = 0
-            self.delivered.append(self._record(st, now))
+            rec = self._record(st, now)
+            note_delivery(rec)
+            self.delivered.append(rec)
 
     def _record(self, st, now: float) -> dict:
         arr = st.req.arrival_s
