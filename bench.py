@@ -705,9 +705,14 @@ def _serve_arm() -> None:
     Runs ``benchmarks/serve_bench.py`` in a child: continuous vs static
     batching over the same seeded open-loop trace, p50/p99 latency and
     TTFT, throughput, batch occupancy, the zero-steady-recompile
-    assertion, and the in-process graftcheck verdict. Defaults to the
-    pool-free CPU self-test (``GRAFT_BENCH_PLATFORM=cpu``) unless the
-    caller pins a platform.
+    assertion, and the in-process graftcheck verdict (which now also
+    covers ``serve-slo-burn``). The child's record carries the request-
+    lifecycle accounting: per-phase latency breakdowns, the p99 tail
+    attribution, ``slo_burn_rate``, and ``telemetry_overhead_fraction``
+    (the lifecycle bookkeeping's own measured cost, gated at 1% — the
+    child exits 9 over it, surfaced here as an error record). Defaults
+    to the pool-free CPU self-test (``GRAFT_BENCH_PLATFORM=cpu``)
+    unless the caller pins a platform.
     """
     env = dict(os.environ)
     env.setdefault("GRAFT_BENCH_PLATFORM", "cpu")
@@ -727,6 +732,15 @@ def _serve_arm() -> None:
         )
     except subprocess.TimeoutExpired:
         _emit_error("serve arm: serve_bench.py hung >600s")
+        return
+    if proc.returncode == 9:
+        # the child's telemetry-overhead gate: lifecycle bookkeeping cost
+        # more than 1% of the measured arm — the record was withheld
+        tail = (proc.stdout or "").strip().splitlines()
+        _emit_error(
+            "serve arm: telemetry overhead over the 1% gate: "
+            + (tail[-1] if tail else "")
+        )
         return
     if proc.returncode != 0:
         tail = (proc.stderr or proc.stdout or "")[-500:]

@@ -82,6 +82,9 @@ STAGES = [
     ("bench_trace", "bench.py with op-trace capture"),
     ("decode", "GPT-2 decode throughput (decode_bench.py)"),
     ("serve", "continuous-batching serving engine SLO bench (serve_bench.py)"),
+    ("slo", "serve request-lifecycle rollup: per-request phase rows + "
+            "tail attribution (trace_summary.py over the graft-serve "
+            "lanes serve_bench exports)"),
     ("ladder", "five-config ladder (ladder.py --all)"),
 ]
 

@@ -19,14 +19,27 @@ p99). A fault-chaos sub-run exercises the two serving fault sites:
 killing the engine, ``serve.client``/sleep is a slow reader whose stall
 the engine accounts.
 
+Each arm also carries its request-lifecycle accounting
+(``observe/slo.py``): a per-phase latency breakdown (queue_wait /
+prefill / decode / stall / deliver / other, summing to wall latency), a
+p99 **tail attribution** (which phase owns the tail, and how much of it
+is bucket/batch padding vs genuine compute — asserted non-empty), and
+the SLO tracker's burn rate. The lifecycle bookkeeping's own cost is
+measured in-process and published as ``telemetry_overhead_fraction``,
+gated at the same 1% publication bar as bench.py's span probe (exit 9
+over it). The continuous arm's lifecycles are exported as a
+``graft-serve`` Chrome-trace lane for ``trace_summary.py``.
+
 One JSON line:
     {"metric": "serve_slo", "continuous": {p50/p99 latency + TTFT,
-     tokens/sec, occupancy, steady_recompiles}, "static": {...},
+     tokens/sec, occupancy, steady_recompiles, phase_breakdown_s,
+     tail_attribution, slo}, "static": {...}, "slo_burn_rate": ...,
+     "telemetry_overhead_fraction": ...,
      "continuous_beats_static": bool, "graftcheck_clean": bool, ...}
 
 Env: GRAFT_BENCH_PLATFORM=cpu -> tiny-model CPU self-test;
 GRAFT_SERVE_BENCH_REQUESTS / GRAFT_SERVE_BENCH_GAP_MS resize the trace;
-the engine's own GRAFT_SERVE_* knobs apply on top.
+the engine's own GRAFT_SERVE_* / GRAFT_SERVE_SLO_* knobs apply on top.
 """
 
 from __future__ import annotations
@@ -69,7 +82,8 @@ def _pct(vals, q):
 
 
 def _arm(cfg, params, trace, admission, knobs, realtime):
-    """One engine arm over a (copied) trace; returns its summary."""
+    """One engine arm over a (copied) trace; returns (summary, engine)."""
+    from pytorch_distributedtraining_tpu.observe import slo as slo_mod
     from pytorch_distributedtraining_tpu.serve.engine import ServeEngine
     from pytorch_distributedtraining_tpu.serve.scheduler import Request
 
@@ -88,6 +102,11 @@ def _arm(cfg, params, trace, admission, knobs, realtime):
     ttft = [r["ttft_s"] for r in records if r["ttft_s"] is not None]
     new_tokens = sum(r["new_tokens"] for r in records)
     m = eng.metrics()
+    completed = eng.ledger.completed
+    phase_sum: dict = {}
+    for r in completed:
+        for phase, secs in r["phases"].items():
+            phase_sum[phase] = phase_sum.get(phase, 0.0) + secs
     return {
         "admission": admission,
         "delivered": len(records),
@@ -102,7 +121,54 @@ def _arm(cfg, params, trace, admission, knobs, realtime):
         "ticks": m["ticks"],
         "steady_recompiles": m["steady_recompiles"],
         "compiled_programs": m["compiled_programs"],
-    }
+        # request-lifecycle accounting (observe/slo.py): where the
+        # latency went, phase-by-phase, and who owns the tail
+        "phase_breakdown_s": {
+            k: round(v, 6) for k, v in sorted(
+                phase_sum.items(), key=lambda kv: -kv[1]
+            )
+        },
+        "phase_p50_s": slo_mod.phase_quantiles(completed, 50),
+        "phase_p99_s": slo_mod.phase_quantiles(completed, 99),
+        "tail_attribution": slo_mod.tail_attribution(completed),
+        "slo": m["slo"],
+    }, eng
+
+
+def _ledger_overhead_fraction(eng, wall_s: float) -> float:
+    """Measured cost of the lifecycle bookkeeping, as a fraction of the
+    arm's wall time — the serving twin of bench.py's span probe. A
+    scratch ledger absorbs 2000 interval closes to price one op, then
+    the arm's actual op count (intervals recorded + per-tick gauge
+    stores) converts it to seconds."""
+    from pytorch_distributedtraining_tpu.observe.slo import RequestLedger
+
+    probe = RequestLedger()
+    probe.begin("probe")
+    n = 2000
+    t0 = time.perf_counter()
+    t = t0
+    for _ in range(n):
+        t2 = time.perf_counter()
+        probe.add_phase(
+            "probe", "decode", t, t2,
+            active_slots=1, share=1.0, padding_fraction=0.0,
+        )
+        t = t2
+    per_op = (time.perf_counter() - t0) / n
+    # the per-tick rolling-gauge store is a 4-key dict update, priced at
+    # its own (much cheaper) rate rather than the add_phase rate
+    g: dict = {}
+    t0 = time.perf_counter()
+    for i in range(n):
+        g.update({
+            "serve_queue_depth": float(i), "serve_slot_occupancy": 0.5,
+            "serve_kv_pages_free": 1.0, "serve_slo_burn_rate": 0.0,
+        })
+    per_gauge = (time.perf_counter() - t0) / n
+    n_intervals = sum(len(r["intervals"]) for r in eng.ledger.completed)
+    cost = per_op * n_intervals + per_gauge * eng._tick
+    return cost / wall_s if wall_s else 0.0
 
 
 def _chaos(cfg, params, knobs):
@@ -132,12 +198,23 @@ def _chaos(cfg, params, knobs):
         m = eng.metrics()
     finally:
         install_plan(None)
+    # lifecycle completeness under fault: every submitted request's
+    # record closed (shed requests terminally), stall billed as stall
+    completed = eng.ledger.completed
+    outcomes = sorted(r["outcome"] for r in completed)
     return {
         "submitted": len(reqs),
         "delivered": len(delivered),
         "dropped_at_admit": m["dropped_at_admit"],
         "slow_reader_stall_s": round(m["slow_reader_stall_s"], 4),
         "engine_survived": True,
+        "lifecycles_closed": (
+            len(completed) == len(reqs) and not eng.ledger._open
+        ),
+        "lifecycle_outcomes": outcomes,
+        "stall_billed_s": round(sum(
+            r["phases"].get("stall", 0.0) for r in completed
+        ), 4),
     }
 
 
@@ -184,12 +261,17 @@ def run_serve_bench(*, realtime: bool = True) -> dict:
     # conversion jits, first host<->device transfers) that would
     # otherwise all be billed to whichever measured arm runs first
     _arm(cfg, params, trace_reqs[:3], "continuous", knobs, False)
-    continuous = _arm(cfg, params, trace_reqs, "continuous", knobs, realtime)
-    static = _arm(cfg, params, trace_reqs, "static", knobs, realtime)
+    continuous, c_eng = _arm(
+        cfg, params, trace_reqs, "continuous", knobs, realtime
+    )
+    static, _ = _arm(cfg, params, trace_reqs, "static", knobs, realtime)
     chaos = _chaos(cfg, params, knobs)
+    overhead = _ledger_overhead_fraction(c_eng, continuous["wall_s"])
+    serve_trace_path = c_eng.export_serve_trace()
 
     # graftcheck runtime plane over the live process: the recompile rule
-    # reads serve.engine.runtime_stats; ERROR findings fail the record
+    # reads serve.engine.runtime_stats, the burn rule reads
+    # observe.slo.runtime_stats; ERROR findings fail the record
     report = run_rules(
         AnalysisContext(platform=jax.default_backend()),
         planes=("runtime",),
@@ -199,7 +281,9 @@ def run_serve_bench(*, realtime: bool = True) -> dict:
         for f in report.findings
     ]
     serve_findings = [
-        f for f in findings if f["rule"] == "serve-recompile-under-load"
+        f for f in findings
+        if f["rule"] == "serve-recompile-under-load"
+        or (f["rule"] == "serve-slo-burn" and f["severity"] == "ERROR")
     ]
 
     ledger = GoodputLedger.from_tracer(
@@ -219,6 +303,10 @@ def run_serve_bench(*, realtime: bool = True) -> dict:
         "static": static,
         "continuous_beats_static": beats,
         "steady_recompiles": continuous["steady_recompiles"],
+        "slo_burn_rate": continuous["slo"]["burn_rate"],
+        "tail_attribution": continuous["tail_attribution"],
+        "telemetry_overhead_fraction": round(overhead, 6),
+        "serve_trace": serve_trace_path,
         "graftcheck_clean": not serve_findings,
         "graftcheck_findings": findings,
         "chaos": chaos,
@@ -243,6 +331,20 @@ def main() -> None:
         f"{record['graftcheck_findings']}"
     )
     assert record["graftcheck_clean"], record["graftcheck_findings"]
+    # the tail attribution is the point of the lifecycle plumbing: an
+    # empty one means no request completed its phase accounting
+    assert record["tail_attribution"].get("dominant_phase"), (
+        "p99 tail attribution is empty — lifecycle records missing"
+    )
+    assert record["slo_burn_rate"] is not None, "SLO tracker saw no requests"
+    if record["telemetry_overhead_fraction"] > 0.01:
+        print(
+            "TELEMETRY OVERHEAD: lifecycle bookkeeping cost "
+            f"{record['telemetry_overhead_fraction']:.2%} of the "
+            "continuous arm's wall time (gate: 1%) — record withheld",
+            flush=True,
+        )
+        raise SystemExit(9)
     print(json.dumps(record), flush=True)
 
 

@@ -14,6 +14,11 @@ trace-event format, so they share the summarizer:
   up by category — the stdout twin of the goodput ledger's
   time_breakdown — plus instant-event counts (fault injections,
   recompiles).
+- serving lifecycle exports (``serve-<pid>.trace.json`` from
+  ``observe/slo.py``; process_name starts with ``graft-serve``): rolls
+  the per-slot lanes back up into one row per request — id, latency,
+  per-phase breakdown in ms, slot, prefill buckets touched — the
+  tabular twin of the Perfetto view the flow arrows draw.
 
     python benchmarks/trace_summary.py /tmp/tpu_results/xplane --top 25
     python benchmarks/trace_summary.py /tmp/graft-runs/<pid> --top 25
@@ -172,6 +177,59 @@ def telemetry_rollup(events, top: int):
     return rows, total
 
 
+def serve_rollup(events):
+    """Per-request rows from graft-serve lanes (observe/slo.py export).
+
+    Each lane interleaves many requests' phase intervals (slot lanes are
+    shared, the flow arrows tie one request's chain together); this
+    inverts the layout — group the X events by request id and report
+    the same per-phase breakdown the bench record carries. Flow events
+    (ph s/t/f) carry no duration and are skipped.
+    """
+    threads = {
+        (e["pid"], e.get("tid")): e.get("args", {}).get("name", "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    per_req: dict = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args", {})
+        uid = args.get("uid") or args.get("rid")
+        if uid is None:
+            continue
+        row = per_req.setdefault(str(uid), {
+            "rid": args.get("rid"),
+            "t0": e["ts"], "t1": e["ts"] + e.get("dur", 0.0),
+            "phase_ms": collections.Counter(),
+            "slot": None, "buckets": set(),
+        })
+        row["t0"] = min(row["t0"], e["ts"])
+        row["t1"] = max(row["t1"], e["ts"] + e.get("dur", 0.0))
+        row["phase_ms"][e.get("name", "?")] += e.get("dur", 0.0)
+        lane = threads.get((e.get("pid"), e.get("tid")), "")
+        if lane.startswith("slot"):
+            row["slot"] = lane
+        if "bucket" in args:
+            row["buckets"].add(args["bucket"])
+    rows = []
+    for uid, row in per_req.items():
+        rows.append({
+            "request": uid,
+            "rid": row["rid"],
+            "latency_ms": round((row["t1"] - row["t0"]) / 1e3, 3),
+            "phase_ms": {
+                k: round(v / 1e3, 3)
+                for k, v in row["phase_ms"].most_common()
+            },
+            "slot": row["slot"],
+            "buckets": sorted(row["buckets"]),
+        })
+    rows.sort(key=lambda r: -r["latency_ms"])
+    return rows
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("trace_dir")
@@ -187,8 +245,25 @@ def main(argv=None):
         pid for pid, name in lanes.items()
         if (name or "").startswith("graft-telemetry")
     }
+    serve_pids = {
+        pid for pid, name in lanes.items()
+        if (name or "").startswith("graft-serve")
+    }
     tel_events = [e for e in events if e.get("pid") in tel_pids]
-    op_events = [e for e in events if e.get("pid") not in tel_pids]
+    serve_events = [e for e in events if e.get("pid") in serve_pids]
+    op_events = [
+        e for e in events
+        if e.get("pid") not in tel_pids and e.get("pid") not in serve_pids
+    ]
+    if serve_events:
+        rows = serve_rollup(serve_events)
+        print(json.dumps({
+            "serve_lanes": sorted(lanes[p] for p in serve_pids),
+            "n_requests": len(rows),
+            "n_events": len(serve_events),
+        }))
+        for r in rows[:opt.top]:
+            print(json.dumps(r))
     if tel_events:
         rows, total = telemetry_rollup(tel_events, opt.top)
         print(json.dumps({
@@ -223,7 +298,9 @@ def main(argv=None):
                 }))
         for r in rows:
             print(json.dumps(r))
-    if not tel_events or any(e.get("ph") == "X" for e in op_events):
+    if not (tel_events or serve_events) or any(
+        e.get("ph") == "X" for e in op_events
+    ):
         lanes_op, rows, total = summarize(op_events, opt.top)
         print(json.dumps({
             "lanes": sorted(set(lanes_op.values())),

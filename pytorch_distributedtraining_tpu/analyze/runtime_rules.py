@@ -202,6 +202,56 @@ def serve_recompile_under_load(ctx):
 
 
 @rule(
+    "serve-slo-burn",
+    "runtime",
+    "serving error budget burning faster than provisioned",
+)
+def serve_slo_burn(ctx):
+    # sys.modules, never imported: observe.slo is stdlib-only but its
+    # package __init__ pulls jax — the serving engine's SLOTracker
+    # populates runtime_stats before this plane runs
+    slo = sys.modules.get("pytorch_distributedtraining_tpu.observe.slo")
+    stats = getattr(slo, "runtime_stats", None)
+    if not stats or not stats.get("requests"):
+        return
+    remaining = stats.get("budget_remaining")
+    peak = stats.get("burn_rate_peak") or 0.0
+    evidence = (
+        f"objective={stats.get('objective')!r} "
+        f"requests={stats.get('requests')} "
+        f"violations={stats.get('violations')} "
+        f"burn_rate_peak={peak:.3g} "
+        f"budget_remaining={remaining}"
+    )
+    if remaining is not None and remaining <= 0:
+        yield Finding(
+            "serve-slo-burn",
+            Severity.ERROR,
+            "runtime:serve",
+            "the serving error budget is EXHAUSTED: the run's all-time "
+            "violation rate exceeds the budgeted miss fraction, so the "
+            "latency/TTFT objective is already broken for this window — "
+            "shed load (tighten admission), add slots/pages, or loosen "
+            "GRAFT_SERVE_SLO_LATENCY_MS if the objective was aspirational",
+            evidence=evidence,
+        )
+        return
+    if peak > 1.0:
+        yield Finding(
+            "serve-slo-burn",
+            Severity.WARN,
+            "runtime:serve",
+            f"serving SLO burn rate peaked at {peak:.2f}x the provisioned "
+            "error budget: violations are arriving faster than budgeted, "
+            "and at this pace the budget exhausts before the window does. "
+            "Check the tail attribution (queue_wait => admission-bound, "
+            "prefill padding => re-bucket, stall => slow readers) before "
+            "the WARN becomes the exhausted-budget ERROR",
+            evidence=evidence,
+        )
+
+
+@rule(
     "bench-regression",
     "runtime",
     "a fresh bench record regressed against the BENCH_* trajectory",

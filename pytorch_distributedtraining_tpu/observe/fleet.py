@@ -582,7 +582,7 @@ class MetricsExporter:
 
 
 def _serve_rolling_hists() -> dict:
-    """The serving engine's rolling TTFT/latency histograms via
+    """The serving engine's rolling TTFT/latency/per-phase histograms via
     sys.modules — never imported (the engine pulls jax; this module must
     stay stdlib-importable)."""
     eng = sys.modules.get("pytorch_distributedtraining_tpu.serve.engine")
@@ -591,6 +591,18 @@ def _serve_rolling_hists() -> dict:
         name: h for name, h in rolling.items()
         if isinstance(h, StreamHist)
     }
+
+
+def _serve_rolling_gauges() -> dict:
+    """The serving engine's per-tick health gauges (queue depth, slot
+    occupancy, free KV pages, SLO burn rate) plus the SLO tracker's
+    budget counters — same sys.modules contract as the histograms."""
+    out: dict = {}
+    eng = sys.modules.get("pytorch_distributedtraining_tpu.serve.engine")
+    for name, v in (getattr(eng, "rolling_gauges", None) or {}).items():
+        if isinstance(v, (int, float)):
+            out[str(name)] = float(v)
+    return out
 
 
 class RankMetricsPublisher:
@@ -648,6 +660,9 @@ class RankMetricsPublisher:
         hists = dict(self.hists)
         hists.update(_serve_rolling_hists())
         doc: dict = {"hists": {k: h.to_dict() for k, h in hists.items()}}
+        gauges = _serve_rolling_gauges()
+        if gauges:
+            doc["gauges"] = gauges
         if self.offset is not None:
             doc["clock_offset_s"] = self.offset.offset_s
             doc["clock_uncertainty_s"] = self.offset.uncertainty_s
@@ -740,6 +755,7 @@ class FleetMonitor:
         )
         self.report = report
         self._note_stragglers(report)
+        serve_gauges: dict = {}
         for doc in self._published():
             for name, payload in (doc.get("hists") or {}).items():
                 try:
@@ -756,10 +772,20 @@ class FleetMonitor:
                         continue  # foreign bounds cannot merge
                 else:
                     hists[pname] = incoming
+            # serving-health gauges ride the same snapshot, labelled per
+            # rank so one dragging engine is visible next to the fleet's
+            for name, v in (doc.get("gauges") or {}).items():
+                if not isinstance(v, (int, float)):
+                    continue
+                pname = re.sub(r"[^a-zA-Z0-9_:]", "_", str(name))
+                serve_gauges[
+                    f'{pname}{{rank="{int(doc.get("rank", -1))}"}}'
+                ] = float(v)
         gauges = {
             "fleet_ranks": float(len(times)),
             "fleet_stragglers": float(len(report.stragglers)),
         }
+        gauges.update(serve_gauges)
         for r in report.stragglers:
             gauges[f'fleet_straggler_rank{{rank="{int(r)}"}}'] = 1.0
         with self._lock:

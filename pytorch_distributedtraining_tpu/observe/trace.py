@@ -555,6 +555,17 @@ def flush_flight_record(
             "recent": recs,
             "dropped": _TRACER.dropped,
         }
+        # the serving half: which requests were in flight, and in what
+        # lifecycle phase, when the process died. sys.modules lookup, not
+        # an import — the SLO ledger is only consulted when the serve
+        # plane is actually live in this process
+        slo_mod = sys.modules.get(
+            "pytorch_distributedtraining_tpu.observe.slo"
+        )
+        if slo_mod is not None:
+            serve_inflight = slo_mod.inflight_requests()
+            if serve_inflight:
+                doc["serve_in_flight"] = serve_inflight
         if exc is not None:
             doc["exception"] = {
                 "type": type(exc).__name__,
@@ -620,6 +631,16 @@ def describe_flight_record(doc: dict) -> str:
         f"in span '{inflight[-1]['name']}' ({inflight[-1]['cat']})"
         if inflight else "between spans"
     )
+    serve = doc.get("serve_in_flight") or []
+    if serve:
+        phases = ", ".join(
+            f"{r.get('rid', '?')}:{r.get('phase', '?')}" for r in serve[:4]
+        )
+        more = f" +{len(serve) - 4} more" if len(serve) > 4 else ""
+        doing += (
+            f" with {len(serve)} serve request(s) in flight "
+            f"({phases}{more})"
+        )
     cause = f" [{exc['type']}: {exc['message']}]" if exc else ""
     return (
         f"rank {doc.get('rank', '?')} pid {doc.get('pid', '?')} "

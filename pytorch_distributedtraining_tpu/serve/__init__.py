@@ -35,6 +35,22 @@ Env knobs (the ``GRAFT_SERVE_*`` family, resolved by
 ``GRAFT_SERVE_TILE_BATCH``   tiles per compiled SwinIR batch (default 4)
 ``GRAFT_SERVE_TILE_OVERLAP`` tile overlap in pixels (default 8)
 ===========================  ==============================================
+
+SLO knobs (the ``GRAFT_SERVE_SLO_*`` family, resolved by
+:func:`slo_knobs_from_env` into the engine's
+:class:`~..observe.slo.SLOTracker` — see ``docs/OBSERVABILITY.md``):
+
+==============================  ===========================================
+``GRAFT_SERVE_SLO_LATENCY_MS``  per-request latency objective in ms
+                                (default 60000)
+``GRAFT_SERVE_SLO_TTFT_MS``     time-to-first-token objective in ms
+                                (default: unset — latency-only)
+``GRAFT_SERVE_SLO_FRACTION``    fraction of requests that must meet the
+                                objective (default 0.99; the error budget
+                                is the remaining 1%)
+``GRAFT_SERVE_SLO_WINDOW_S``    rolling burn-rate window in seconds
+                                (default 60)
+==============================  ===========================================
 """
 
 from __future__ import annotations
@@ -48,8 +64,18 @@ __all__ = [
     "ServeEngine",
     "SwinIRTileServer",
     "serve_knobs_from_env",
+    "slo_knobs_from_env",
     "build_engine",
 ]
+
+
+def slo_knobs_from_env(env=None) -> dict:
+    """Resolve ``GRAFT_SERVE_SLO_*`` into SLOTracker kwargs (the
+    implementation lives in the stdlib-only :mod:`..observe.slo` so the
+    jax-free tooling can resolve the same knobs)."""
+    from ..observe.slo import slo_knobs_from_env as _impl
+
+    return _impl(env)
 
 
 def serve_knobs_from_env(env=None) -> dict:

@@ -34,6 +34,18 @@ Telemetry lands in per-bucket lanes (``serve.prefill`` / ``serve.decode``
 via :func:`observe.trace.bucket_dispatch_span`): the first dispatch of
 each bucket is a ``compile`` span, steady dispatches are ``step`` spans
 and therefore count as productive time in the goodput ledger.
+
+Request observability (:mod:`..observe.slo`): every request gets a
+run-unique id and a lifecycle record of typed phase intervals —
+``queue_wait`` (enqueue→admit), ``prefill`` (per chunk, carrying bucket
+id + padding fraction), ``decode`` (each batched tick billed to every
+resident slot, carrying its residency share + idle-row padding),
+``stall`` (slow-reader time at delivery), ``deliver`` — whose buckets sum
+exactly to the request's wall latency. The ledger exports a
+``graft-serve`` Chrome-trace lane (:meth:`ServeEngine.export_serve_trace`),
+feeds per-phase rolling histograms + SLO gauges the fleet plane
+publishes (:data:`rolling_hists` / :data:`rolling_gauges`), and names
+in-flight requests in the crash flight record.
 """
 
 from __future__ import annotations
@@ -47,6 +59,7 @@ import numpy as np
 
 from ..models.generate import init_paged_cache, sample_logits
 from ..models.gpt2 import GPT2, default_attention
+from ..observe import slo as _slo
 from ..observe import trace
 from ..resilience.faults import InjectedFault, fault_point
 from ..runtime.cache import jit_cache_size
@@ -72,6 +85,12 @@ runtime_stats = {
 # controller merges one rank's TTFT histogram with another's by count sum.
 rolling_hists: dict = {}
 
+# Rolling serve gauges, same sys.modules contract: the engine overwrites
+# them every tick (plain float stores — the 1% telemetry-overhead gate
+# measures the whole per-tick bookkeeping cost), the fleet plane
+# publishes them per rank next to the histograms.
+rolling_gauges: dict = {}
+
 
 def note_delivery(rec: dict) -> None:
     from ..observe.fleet import StreamHist
@@ -84,6 +103,13 @@ def note_delivery(rec: dict) -> None:
         if v is None:
             continue
         rolling_hists.setdefault(name, StreamHist()).observe(float(v))
+    # per-phase rolling histograms: the fleet plane's p50/p99-per-phase
+    # view ("is the fleet's tail queue-bound or decode-bound") without
+    # shipping raw lifecycle records off-host
+    for phase, secs in (rec.get("phases") or {}).items():
+        rolling_hists.setdefault(
+            f"serve_phase_{phase}_seconds", StreamHist()
+        ).observe(float(secs))
 
 
 class ServeEngine:
@@ -112,6 +138,7 @@ class ServeEngine:
         top_p: float | None = None,
         seed: int = 0,
         admission: str = "continuous",
+        slo: _slo.SLOTracker | None = None,
     ):
         self.cfg = cfg
         self.params = params
@@ -137,6 +164,14 @@ class ServeEngine:
         )
         self._rng = jax.random.PRNGKey(seed)
 
+        # request-lifecycle accounting: the ledger assembles per-request
+        # phase intervals (ids are run-unique via the ledger's run_id);
+        # the tracker holds the latency/TTFT objective + burn rate
+        self.ledger = _slo.RequestLedger()
+        self.slo = (
+            slo if slo is not None
+            else _slo.SLOTracker(**_slo.slo_knobs_from_env())
+        )
         self.pool = PagePool(self.num_pages, self.page_size)
         self.sched = AdmissionScheduler(
             n_slots=self.n_slots,
@@ -145,6 +180,7 @@ class ServeEngine:
             prefill_chunk=self.prefill_chunk,
             prefill_buckets=self.prefill_buckets,
             admission=admission,
+            ledger=self.ledger,
         )
 
         self.model = GPT2(
@@ -300,6 +336,7 @@ class ServeEngine:
         start, size, bucket = self.sched.prefill_chunk_for(st)
         chunk = np.zeros((1, bucket), np.int32)
         chunk[0, :size] = st.req.prompt[start : start + size]
+        t0 = time.perf_counter()
         with trace.bucket_dispatch_span(self, "serve.prefill", bucket):
             self._pages, tok = self._prefill_fns[bucket](
                 self.params, self._pages, jnp.asarray(chunk),
@@ -309,11 +346,19 @@ class ServeEngine:
             )
         st.prefilled += size
         if st.prefilled == st.req.prompt_len:
-            first = int(np.asarray(tok)[0])
+            first = int(np.asarray(tok)[0])  # device sync: TTFT lands here
             st.tokens.append(first)
             st.first_token_s = now
+            st.first_token_pc = time.perf_counter()
             st.state = DECODE
             self._lengths[st.slot] = st.req.prompt_len
+        # bucket waste is first-class: padding_fraction is the unused
+        # tail of the compiled [1, bucket] shape this chunk dispatched at
+        self.ledger.add_phase(
+            st.rid, "prefill", t0, time.perf_counter(),
+            bucket=bucket, tokens=size,
+            padding_fraction=round(1.0 - size / bucket, 4),
+        )
         return True
 
     def _decode_tick(self, now: float) -> list:
@@ -329,6 +374,7 @@ class ServeEngine:
             pt[st.slot] = self._page_table[st.slot]
             lens[st.slot] = self._lengths[st.slot]
             toks[st.slot, 0] = st.tokens[-1]
+        t0 = time.perf_counter()
         with trace.bucket_dispatch_span(
             self, "serve.decode", self.n_slots
         ):
@@ -336,9 +382,21 @@ class ServeEngine:
                 self.params, self._pages, jnp.asarray(toks),
                 jnp.asarray(pt), jnp.asarray(lens), self._next_rng(),
             )
-        out = np.asarray(out)
+        out = np.asarray(out)  # device sync: the tick's tokens land here
+        t1 = time.perf_counter()
+        # decode is batched: every resident request waits out the whole
+        # tick, so each is billed the full interval (phases must sum to
+        # wall latency) and carries its residency share + the idle-row
+        # padding for cost attribution
+        share = round(1.0 / len(active), 4)
+        padding = round(1.0 - len(active) / self.n_slots, 4)
         finished = []
         for st in active:
+            self.ledger.add_phase(
+                st.rid, "decode", t0, t1,
+                active_slots=len(active), share=share,
+                padding_fraction=padding,
+            )
             st.tokens.append(int(out[st.slot]))
             self._lengths[st.slot] += 1
             if len(st.tokens) >= st.req.max_new_tokens:
@@ -352,18 +410,37 @@ class ServeEngine:
                 # a "sleep" plan stalls here = slow reader holding the
                 # tick loop; a "raise" plan is a client disconnect
                 fault_point("serve.client", rid=st.rid)
+                ok = True
             except InjectedFault:
+                ok = False
+            t1 = time.perf_counter()
+            self._slow_reader_s += t1 - t0
+            # reader time bills to `stall`, never to `decode`: the tokens
+            # were already generated when the client dragged its feet
+            self.ledger.add_phase(st.rid, "stall", t0, t1)
+            if not ok:
                 self.cancelled.append(st.rid)
                 self.sched.retire(st, now, state=DROPPED)
                 self._page_table[st.slot] = 0
                 self._lengths[st.slot] = 0
+                self.ledger.complete(st.rid, outcome=_slo.CANCELLED)
                 continue
-            finally:
-                self._slow_reader_s += time.perf_counter() - t0
             self.sched.retire(st, now)
             self._page_table[st.slot] = 0
             self._lengths[st.slot] = 0
+            td = time.perf_counter()
             rec = self._record(st, now)
+            self.ledger.add_phase(st.rid, "deliver", td, time.perf_counter())
+            life = self.ledger.complete(st.rid)
+            rec["req_id"] = life["uid"]
+            rec["slot"] = life["slot"]
+            rec["wall_s"] = life["wall_s"]
+            rec["phases"] = life["phases"]
+            self.slo.observe(
+                life["wall_s"],
+                None if st.first_token_pc is None
+                else st.first_token_pc - life["t_start"],
+            )
             note_delivery(rec)
             self.delivered.append(rec)
 
@@ -393,6 +470,15 @@ class ServeEngine:
         )
         self._retire(finished, now)
         self._tick += 1
+        # serving-health gauges, overwritten every tick: plain float
+        # stores into a module dict the fleet publisher reads via
+        # sys.modules — cheap enough to live inside the 1% overhead gate
+        rolling_gauges.update({
+            "serve_queue_depth": float(len(self.sched.queue)),
+            "serve_slot_occupancy": len(self.sched.active) / self.n_slots,
+            "serve_kv_pages_free": float(self.pool.available),
+            "serve_slo_burn_rate": self.slo.burn_rate(),
+        })
 
     def run(self, requests, *, realtime: bool = True) -> list[dict]:
         """Serve an open-loop trace: each request is submitted at its
@@ -447,4 +533,14 @@ class ServeEngine:
             "steady_recompiles": self.steady_recompiles(),
             "compiled_programs": jit_cache_size(*self._all_jitted()),
             "slow_reader_stall_s": self._slow_reader_s,
+            "slo": self.slo.snapshot(),
         }
+
+    def tail_attribution(self, q: float = 99.0) -> dict:
+        """Phase attribution of the latency tail (>= q-th percentile)."""
+        return _slo.tail_attribution(self.ledger.completed, q=q)
+
+    def export_serve_trace(self, path: str | None = None) -> str:
+        """Write completed lifecycles as the ``graft-serve`` Chrome-trace
+        lane (one thread lane per slot, flow arrows per request)."""
+        return _slo.export_serve_trace(self.ledger.completed, path)

@@ -24,6 +24,14 @@ Policy (kept simple and provable, in the tests' order of interest):
 Fault site ``serve.admit`` fires per admission decision: a ``raise``
 action drops that request (counted, never crashes the engine) — the
 "admission controller sheds load" drill.
+
+Lifecycle accounting: when constructed with a
+:class:`~..observe.slo.RequestLedger`, the scheduler opens each
+request's lifecycle at :meth:`~AdmissionScheduler.submit` (the
+``queue_wait`` clock starts at enqueue), closes ``queue_wait`` at
+admission, and gives shed requests their terminal ``shed`` phase — so
+every submitted request's record is complete even when it never reaches
+a slot.
 """
 
 from __future__ import annotations
@@ -81,6 +89,7 @@ class RequestState:
     tokens: list[int] = field(default_factory=list)  # generated ids
     admitted_s: float = 0.0
     first_token_s: float | None = None  # TTFT clock (vs req.arrival_s)
+    first_token_pc: float | None = None  # TTFT on the lifecycle clock
     done_s: float | None = None
 
     @property
@@ -121,6 +130,7 @@ class AdmissionScheduler:
         prefill_chunk: int = 32,
         prefill_buckets: tuple[int, ...] = (8, 16, 32),
         admission: str = "continuous",
+        ledger=None,
     ):
         if admission not in ("continuous", "static"):
             raise ValueError(f"unknown admission policy {admission!r}")
@@ -135,6 +145,7 @@ class AdmissionScheduler:
         self.prefill_chunk = prefill_chunk
         self.prefill_buckets = tuple(sorted(prefill_buckets))
         self.admission = admission
+        self.ledger = ledger  # observe.slo.RequestLedger | None
         self.queue: deque[Request] = deque()
         self.active: dict[int, RequestState] = {}  # slot -> state
         self.free_slots: list[int] = list(range(n_slots))  # min-id first
@@ -154,6 +165,8 @@ class AdmissionScheduler:
                 f"{self.max_pages_per_slot}"
             )
         self.queue.append(req)
+        if self.ledger is not None:
+            self.ledger.begin(req.rid)  # the queue_wait clock starts here
 
     def admit(self, now: float = 0.0) -> list[RequestState]:
         """Admit queue-head requests while slots + pages allow.
@@ -174,6 +187,8 @@ class AdmissionScheduler:
                 fault_point("serve.admit", rid=req.rid)
             except InjectedFault:
                 self.dropped.append(req)  # shed, never crash the engine
+                if self.ledger is not None:
+                    self.ledger.shed(req.rid)  # terminal phase, closed
                 continue
             slot = self.free_slots.pop(0)
             pages = self.pool.alloc(need, req.rid)
@@ -181,6 +196,8 @@ class AdmissionScheduler:
             self.active[slot] = st
             self._admit_order.append(slot)
             admitted.append(st)
+            if self.ledger is not None:
+                self.ledger.note_admit(req.rid, slot=slot)
         return admitted
 
     # -- per-tick picks ----------------------------------------------------

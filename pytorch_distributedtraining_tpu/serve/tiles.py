@@ -20,6 +20,14 @@ Like the decode engine, the compiled surface is closed: one program, one
 shape, compiled once at warmup — request size changes the *number* of
 tiles, never the program. Delivery passes the ``serve.client`` fault site
 (``raise`` = disconnect → request cancelled, counted).
+
+Tiled requests get the same lifecycle records as decode requests
+(:mod:`..observe.slo`): ``queue_wait`` runs from submit to the first
+tile batch that carries one of the request's tiles, each batch tick is a
+``tile`` interval billed to every request resident in it (carrying its
+tile count, batch share, and the zero-padded-row fraction), and
+``stall``/``deliver`` close the record at retirement — so a tiled p99 is
+attributable the same way a decode p99 is.
 """
 
 from __future__ import annotations
@@ -32,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observe import slo as _slo
 from ..observe import trace
 from ..resilience.faults import InjectedFault, fault_point
 from ..runtime.cache import jit_cache_size
@@ -85,8 +94,10 @@ class _InFlight:
     weight_canvas: np.ndarray  # [pad_h*up, pad_w*up, 1]
     orig_hw: tuple[int, int] = (0, 0)  # pre-padding size, for the crop
     first_tile_s: float | None = None
+    first_tile_pc: float | None = None  # TTFT on the lifecycle clock
     done_s: float | None = None
     total_tiles: int = 0
+    started: bool = False  # first tile batched -> queue_wait closed
 
 
 class SwinIRTileServer:
@@ -100,12 +111,19 @@ class SwinIRTileServer:
         tile: int = 48,
         tile_batch: int = 4,
         overlap: int = 8,
+        slo: _slo.SLOTracker | None = None,
     ):
         self.model = model
         self.params = params
         self.tile = int(tile)
         self.tile_batch = int(tile_batch)
         self.overlap = int(overlap)
+        # same lifecycle accounting as the decode engine
+        self.ledger = _slo.RequestLedger()
+        self.slo = (
+            slo if slo is not None
+            else _slo.SLOTracker(**_slo.slo_knobs_from_env())
+        )
         self.upscale = int(getattr(model, "upscale", 1))
         self._apply = jax.jit(
             lambda p, x: model.apply({"params": p}, x)
@@ -146,6 +164,7 @@ class SwinIRTileServer:
         )
         self._inflight[req.rid] = st
         self._queue.extend(_TileJob(req.rid, y, x) for (y, x) in grid)
+        self.ledger.begin(req.rid)  # queue_wait clock starts at enqueue
 
     # -- compiled surface --------------------------------------------------
 
@@ -191,16 +210,36 @@ class SwinIRTileServer:
             batch[i] = st.req.image[
                 job.y : job.y + self.tile, job.x : job.x + self.tile
             ]
+            if not st.started:  # first residency: queue_wait ends here
+                st.started = True
+                self.ledger.note_admit(job.rid)
+        t0 = time.perf_counter()
         with trace.bucket_dispatch_span(
             self, "serve.tile", self.tile_batch
         ):
             out = np.asarray(self._apply(self.params, jnp.asarray(batch)))
+        t1 = time.perf_counter()
+        # one tile-batch span per resident request: the batched-compute
+        # attribution rule is the decode engine's — the full interval
+        # bills to everyone resident (wall-sum invariant), share/padding
+        # carry the cost split (zero-padded rows are the batch waste)
+        per_rid: dict = {}
+        for job in jobs:
+            per_rid[job.rid] = per_rid.get(job.rid, 0) + 1
+        pad = round(1.0 - len(jobs) / self.tile_batch, 4)
+        for rid, n in per_rid.items():
+            self.ledger.add_phase(
+                rid, "tile", t0, t1,
+                tiles=n, share=round(n / len(jobs), 4),
+                padding_fraction=pad,
+            )
         up, ts = self.upscale, self.tile * self.upscale
         finished = []
         for i, job in enumerate(jobs):
             st = self._inflight[job.rid]
             if st.first_tile_s is None:
                 st.first_tile_s = now
+                st.first_tile_pc = t1
             y, x = job.y * up, job.x * up
             st.sum_canvas[y : y + ts, x : x + ts] += out[i]
             st.weight_canvas[y : y + ts, x : x + ts] += 1.0
@@ -214,15 +253,23 @@ class SwinIRTileServer:
         for st in finished:
             st.done_s = now
             del self._inflight[st.req.rid]
+            t0 = time.perf_counter()
             try:
                 fault_point("serve.client", rid=st.req.rid)
+                ok = True
             except InjectedFault:
+                ok = False
+            t1 = time.perf_counter()
+            self.ledger.add_phase(st.req.rid, "stall", t0, t1)
+            if not ok:
                 self.cancelled.append(st.req.rid)
+                self.ledger.complete(st.req.rid, outcome=_slo.CANCELLED)
                 continue
             h, w = st.orig_hw
             up = self.upscale
+            td = time.perf_counter()
             img = st.sum_canvas / np.maximum(st.weight_canvas, 1e-8)
-            self.delivered.append({
+            rec = {
                 "rid": st.req.rid,
                 "image": img[: h * up, : w * up],
                 "tiles": st.total_tiles,
@@ -231,7 +278,20 @@ class SwinIRTileServer:
                     None if st.first_tile_s is None
                     else st.first_tile_s - st.req.arrival_s
                 ),
-            })
+            }
+            self.ledger.add_phase(
+                st.req.rid, "deliver", td, time.perf_counter()
+            )
+            life = self.ledger.complete(st.req.rid)
+            rec["req_id"] = life["uid"]
+            rec["wall_s"] = life["wall_s"]
+            rec["phases"] = life["phases"]
+            self.slo.observe(
+                life["wall_s"],
+                None if st.first_tile_pc is None
+                else st.first_tile_pc - life["t_start"],
+            )
+            self.delivered.append(rec)
 
     def run(self, requests, *, realtime: bool = False) -> list[dict]:
         """Serve a trace of :class:`TileRequest`; same loop contract as
@@ -264,4 +324,13 @@ class SwinIRTileServer:
                 if self._occupancy_samples else 0.0
             ),
             "steady_recompiles": self.steady_recompiles(),
+            "slo": self.slo.snapshot(),
         }
+
+    def tail_attribution(self, q: float = 99.0) -> dict:
+        """Phase attribution of the latency tail (>= q-th percentile)."""
+        return _slo.tail_attribution(self.ledger.completed, q=q)
+
+    def export_serve_trace(self, path: str | None = None) -> str:
+        """Write completed lifecycles as the ``graft-serve`` lane."""
+        return _slo.export_serve_trace(self.ledger.completed, path)

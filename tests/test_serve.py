@@ -616,6 +616,19 @@ class TestServeBench:
         assert rec["graftcheck_clean"] is True
         assert rec["chaos"]["dropped_at_admit"] == 1
         assert rec["chaos"]["engine_survived"] is True
+        # request-lifecycle additions: breakdown, tail owner, burn rate,
+        # the overhead gate's input, and chaos lifecycle closure
+        for arm in ("continuous", "static"):
+            assert rec[arm]["phase_breakdown_s"]
+            assert rec[arm]["tail_attribution"]["dominant_phase"]
+            assert rec[arm]["slo"]["requests"] == rec["requests"]
+        assert rec["slo_burn_rate"] is not None
+        assert rec["tail_attribution"]["n_requests"] == rec["requests"]
+        assert 0.0 <= rec["telemetry_overhead_fraction"] < 1.0
+        assert os.path.exists(rec["serve_trace"])
+        assert rec["chaos"]["lifecycles_closed"] is True
+        assert "shed" in rec["chaos"]["lifecycle_outcomes"]
+        assert rec["chaos"]["stall_billed_s"] >= 0.01
 
     @pytest.mark.slow
     def test_subprocess_publishes_json(self):

@@ -1,0 +1,514 @@
+"""Request-lifecycle SLO accounting (observe/slo.py) and its wiring.
+
+The load-bearing guarantees: per-request phase buckets sum exactly to
+wall latency (union-interval semantics); interval hygiene is enforced —
+an out-of-order close raises instead of double-billing; shed requests
+close with a terminal ``shed`` phase and slow-reader time bills to
+``stall``, never ``decode``; the tail attributor separates padding from
+genuine compute; the burn-rate math matches the SRE definition and the
+``serve-slo-burn`` graftcheck rule fires on it; the graft-serve trace
+export carries per-slot lanes plus a flow chain per request; the crash
+flight recorder names in-flight requests; the engine's gauges reach the
+fleet plane labelled per rank.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pytorch_distributedtraining_tpu.analyze import (
+    AnalysisContext,
+    Severity,
+    run_rules,
+)
+from pytorch_distributedtraining_tpu.observe import slo
+from pytorch_distributedtraining_tpu.observe import trace
+from pytorch_distributedtraining_tpu.observe.slo import (
+    RequestLedger,
+    SLOTracker,
+    phase_quantiles,
+    serve_chrome_events,
+    slo_knobs_from_env,
+    tail_attribution,
+)
+
+
+class TestRequestLedger:
+    def test_phases_sum_to_wall(self):
+        led = RequestLedger(run_id="t")
+        led.begin(0, t=0.0)
+        led.note_admit(0, t=1.0, slot=2)
+        led.add_phase(0, "prefill", 1.0, 1.5, bucket=16, tokens=12,
+                      padding_fraction=0.25)
+        led.add_phase(0, "decode", 2.0, 2.5, active_slots=2, share=0.5,
+                      padding_fraction=0.5)
+        led.add_phase(0, "deliver", 2.5, 2.6)
+        rec = led.complete(0, t=2.6)
+        assert rec["uid"] == "t/0"
+        assert rec["slot"] == 2
+        assert rec["wall_s"] == pytest.approx(2.6)
+        # queue 1.0 + prefill 0.5 + decode 0.5 + deliver 0.1 + other 0.5
+        assert rec["phases"]["queue_wait"] == pytest.approx(1.0)
+        assert rec["phases"]["other"] == pytest.approx(0.5)
+        assert sum(rec["phases"].values()) == pytest.approx(rec["wall_s"])
+
+    def test_out_of_order_interval_rejected(self):
+        """The monotonicity assertion: a close that lands before the
+        previous interval ended would double-bill the overlap."""
+        led = RequestLedger(run_id="t")
+        led.begin(0, t=0.0)
+        led.add_phase(0, "prefill", 0.0, 1.0)
+        with pytest.raises(ValueError, match="out-of-order"):
+            led.add_phase(0, "decode", 0.5, 1.5)
+        # and an interval that closes before it opens
+        with pytest.raises(ValueError, match="closes before it opens"):
+            led.add_phase(0, "decode", 2.0, 1.0)
+
+    def test_unknown_phase_and_missing_lifecycle_rejected(self):
+        led = RequestLedger(run_id="t")
+        led.begin(0, t=0.0)
+        with pytest.raises(ValueError, match="unknown phase"):
+            led.add_phase(0, "naptime", 0.0, 1.0)
+        with pytest.raises(ValueError, match="no open lifecycle"):
+            led.add_phase(7, "decode", 0.0, 1.0)
+        with pytest.raises(ValueError, match="already open"):
+            led.begin(0, t=0.5)
+
+    def test_shed_is_terminal_and_bills_queue(self):
+        led = RequestLedger(run_id="t")
+        led.begin(3, t=0.0)
+        rec = led.shed(3, t=0.25)
+        assert rec["outcome"] == "shed"
+        assert rec["phases"]["queue_wait"] == pytest.approx(0.25)
+        assert "shed" in rec["phases"]
+        assert not led._open  # closed, not abandoned
+        assert sum(rec["phases"].values()) == pytest.approx(rec["wall_s"])
+
+    def test_open_requests_and_inflight_view(self):
+        led = RequestLedger(run_id="t")
+        led.begin(5)
+        led.note_admit(5, slot=1)
+        view = led.open_requests()
+        assert [(r["rid"], r["phase"], r["slot"]) for r in view] == [
+            (5, "queue_wait", 1)
+        ]
+        assert any(r["uid"] == "t/5" for r in slo.inflight_requests())
+        led.complete(5)
+        assert led.open_requests() == []
+
+
+def _mk_record(rid, wall, phases, intervals=(), outcome="done"):
+    return {
+        "uid": f"t/{rid}", "rid": rid, "slot": 0, "outcome": outcome,
+        "t_start": 0.0, "t_end": wall, "wall_s": wall,
+        "phases": phases, "intervals": list(intervals),
+    }
+
+
+class TestTailAttribution:
+    def test_dominant_phase_and_padding_split(self):
+        fast = [
+            _mk_record(i, 0.1, {"decode": 0.1}) for i in range(9)
+        ]
+        slow = _mk_record(
+            9, 2.0, {"queue_wait": 1.5, "decode": 0.5},
+            intervals=[
+                ("decode", 1.5, 2.0, {"padding_fraction": 0.5}),
+            ],
+        )
+        out = tail_attribution(fast + [slow], q=99.0)
+        assert out["dominant_phase"] == "queue_wait"
+        assert out["n_tail"] == 1 and out["n_requests"] == 10
+        assert out["compute_seconds"] == pytest.approx(0.5)
+        assert out["padding_seconds"] == pytest.approx(0.25)
+        assert out["padding_fraction"] == pytest.approx(0.5)
+
+    def test_non_done_outcomes_excluded_and_empty_ok(self):
+        assert tail_attribution([]) == {}
+        shed_only = [_mk_record(0, 1.0, {"shed": 0.0}, outcome="shed")]
+        assert tail_attribution(shed_only) == {}
+
+    def test_phase_quantiles(self):
+        recs = [
+            _mk_record(i, 1.0, {"decode": float(i)}) for i in range(1, 11)
+        ]
+        q = phase_quantiles(recs, 50)
+        assert q["decode"] == pytest.approx(5.0)
+        assert phase_quantiles(recs, 99)["decode"] == pytest.approx(10.0)
+
+
+class TestSLOTracker:
+    def _tracker(self, **kw):
+        t = [0.0]
+        base = dict(latency_target_s=1.0, slo_fraction=0.9, window_s=10.0,
+                    clock=lambda: t[0])
+        base.update(kw)
+        return SLOTracker(**base), t
+
+    def test_burn_rate_is_violation_rate_over_budget(self):
+        tr, _ = self._tracker()
+        for _ in range(9):
+            assert not tr.observe(0.5)
+        assert tr.observe(2.0)  # 1 violation in 10 -> rate 0.1, budget 0.1
+        assert tr.burn_rate() == pytest.approx(1.0)
+        assert tr.budget_remaining() == pytest.approx(0.0)
+
+    def test_window_prunes_old_violations(self):
+        tr, t = self._tracker()
+        tr.observe(2.0)  # violation at t=0
+        t[0] = 11.0      # outside the 10s window
+        tr.observe(0.5)
+        assert tr.burn_rate() == pytest.approx(0.0)
+        # all-time budget still remembers it: 1 of 2 violated, budget .1
+        assert tr.budget_remaining() == pytest.approx(1.0 - 5.0)
+
+    def test_ttft_objective_and_gauges(self):
+        tr, _ = self._tracker(ttft_target_s=0.1)
+        assert tr.observe(0.5, ttft_s=0.2)  # latency ok, TTFT violated
+        g = tr.gauges()
+        assert g["serve_slo_violations"] == 1.0
+        assert g["serve_slo_burn_rate"] > 1.0
+        snap = tr.snapshot()
+        assert snap["requests"] == 1 and snap["violations"] == 1
+        assert "ttft<=0.1s" in snap["objective"]
+
+    def test_knobs_from_env(self):
+        kw = slo_knobs_from_env({
+            "GRAFT_SERVE_SLO_LATENCY_MS": "250",
+            "GRAFT_SERVE_SLO_TTFT_MS": "50",
+            "GRAFT_SERVE_SLO_FRACTION": "0.95",
+            "GRAFT_SERVE_SLO_WINDOW_S": "30",
+        })
+        assert kw == dict(latency_target_s=0.25, ttft_target_s=0.05,
+                          slo_fraction=0.95, window_s=30.0)
+        assert slo_knobs_from_env({})["ttft_target_s"] is None
+
+
+class TestSloBurnRule:
+    def _seed(self, **kw):
+        saved = dict(slo.runtime_stats)
+        slo.runtime_stats.update({
+            "requests": 100, "shed": 0, "violations": 0,
+            "burn_rate": 0.0, "burn_rate_peak": 0.0,
+            "budget_remaining": 1.0, "objective": "0.99 latency<=1s",
+        })
+        slo.runtime_stats.update(kw)
+        return saved
+
+    def _findings(self):
+        report = run_rules(
+            AnalysisContext(platform="cpu"), planes=("runtime",),
+            ignore=frozenset(),
+        )
+        return [f for f in report.findings if f.rule == "serve-slo-burn"]
+
+    def test_error_on_exhausted_budget(self):
+        saved = self._seed(violations=5, burn_rate_peak=5.0,
+                           budget_remaining=-4.0)
+        try:
+            hits = self._findings()
+            assert len(hits) == 1
+            assert hits[0].severity is Severity.ERROR
+            assert "EXHAUSTED" in hits[0].message
+            assert "budget_remaining=-4.0" in hits[0].evidence
+        finally:
+            slo.runtime_stats.update(saved)
+
+    def test_warn_on_peak_burn_above_one(self):
+        saved = self._seed(violations=1, burn_rate_peak=2.5,
+                           budget_remaining=0.5)
+        try:
+            hits = self._findings()
+            assert len(hits) == 1
+            assert hits[0].severity is Severity.WARN
+            assert "2.50x" in hits[0].message
+        finally:
+            slo.runtime_stats.update(saved)
+
+    def test_silent_when_healthy_or_idle(self):
+        saved = self._seed(burn_rate_peak=0.8)
+        try:
+            assert not self._findings()
+        finally:
+            slo.runtime_stats.update(saved)
+        saved = self._seed(requests=0, burn_rate_peak=9.0,
+                           budget_remaining=-1.0)
+        try:
+            assert not self._findings()  # no requests -> nothing to judge
+        finally:
+            slo.runtime_stats.update(saved)
+
+
+class TestServeChromeTrace:
+    def _records(self):
+        led = RequestLedger(run_id="t")
+        led.begin(0, t=0.0)
+        led.note_admit(0, t=0.5, slot=1)
+        led.add_phase(0, "prefill", 0.5, 1.0, bucket=16)
+        led.add_phase(0, "decode", 1.0, 2.0, active_slots=1)
+        led.complete(0, t=2.0)
+        led.begin(1, t=0.2)
+        led.shed(1, t=0.4)
+        return led.completed
+
+    def test_lanes_spans_and_flow_chain(self):
+        events = serve_chrome_events(self._records(), pid=42)
+        lanes = {
+            e["args"]["name"] for e in events
+            if e["ph"] == "M" and e["name"] == "thread_name"
+        }
+        assert lanes == {"queue", "slot 1"}
+        spans = [e for e in events if e["ph"] == "X"]
+        # queue_wait/shed live on tid 0, compute phases on the slot lane
+        assert all(
+            e["tid"] == 0 for e in spans
+            if e["name"] in ("queue_wait", "shed")
+        )
+        assert all(
+            e["tid"] == 2 for e in spans
+            if e["name"] in ("prefill", "decode")
+        )
+        assert all("uid" in e["args"] for e in spans)
+        # one flow chain per request: s ... f, f binds enclosing
+        flows = [e for e in events if e["ph"] in ("s", "t", "f")]
+        per_id: dict = {}
+        for e in flows:
+            per_id.setdefault(e["id"], []).append(e["ph"])
+        assert len(per_id) == 2
+        for chain in per_id.values():
+            assert chain[0] == "s" and chain[-1] == "f"
+        assert all(
+            e.get("bp") == "e" for e in flows if e["ph"] == "f"
+        )
+        assert serve_chrome_events([]) == []
+
+    def test_export_writes_trace_file(self, tmp_path):
+        path = slo.export_serve_trace(
+            self._records(), str(tmp_path / "serve.trace.json")
+        )
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        assert doc["graftMeta"]["kind"] == "graft-serve"
+        assert doc["graftMeta"]["n_requests"] == 2
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+
+
+class TestFlightRecorderServe:
+    def test_inflight_requests_reach_flight_record(self, tmp_path):
+        led = RequestLedger(run_id="fr")
+        led.begin(7)
+        led.note_admit(7, slot=0)
+        led.add_phase(7, "decode", led._open[7].last_end,
+                      led._open[7].last_end + 0.001)
+        try:
+            trace.enable(crash_handler=False)
+            path = trace.flush_flight_record(
+                "test", path=str(tmp_path / "flightrec-1.json")
+            )
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        finally:
+            trace.disable()
+            trace.clear()
+            led.complete(7)
+        serve = doc["serve_in_flight"]
+        assert any(
+            r["uid"] == "fr/7" and r["phase"] == "decode" for r in serve
+        )
+        line = trace.describe_flight_record(doc)
+        assert "serve request(s) in flight" in line
+        assert "7:decode" in line
+
+
+class TestEngineLifecycle:
+    """jax-backed: the engine's ledger under normal and chaotic load."""
+
+    @pytest.fixture(scope="class")
+    def served(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        from pytorch_distributedtraining_tpu.models import GPT2, GPT2Config
+        from pytorch_distributedtraining_tpu.resilience.faults import (
+            FaultPlan, install_plan,
+        )
+        from pytorch_distributedtraining_tpu.serve.engine import ServeEngine
+        from pytorch_distributedtraining_tpu.serve.scheduler import Request
+
+        cfg = GPT2Config.tiny(n_embd=32, n_head=4, n_positions=96)
+        model = GPT2(cfg)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        rng = np.random.default_rng(0)
+
+        def _run(plan=None, n=4):
+            reqs = [
+                Request(
+                    i,
+                    rng.integers(0, cfg.vocab_size, size=6).astype(np.int32),
+                    3,
+                )
+                for i in range(n)
+            ]
+            install_plan(plan)
+            try:
+                eng = ServeEngine(
+                    cfg, params, n_slots=2, page_size=8, max_len=48,
+                    prefill_chunk=16, prefill_buckets=(8, 16),
+                    temperature=0.0,
+                )
+                delivered = eng.run(reqs, realtime=False)
+            finally:
+                install_plan(None)
+            return eng, reqs, delivered
+
+        chaos_plan = FaultPlan.from_json([
+            {"site": "serve.admit", "action": "raise", "at": 2, "times": 1},
+            {"site": "serve.client", "action": "sleep", "arg": 0.02,
+             "at": 1, "times": 1},
+        ])
+        return _run(), _run(chaos_plan)
+
+    def test_clean_run_lifecycles_sum_to_wall(self, served):
+        (eng, reqs, delivered), _ = served
+        completed = eng.ledger.completed
+        assert len(completed) == len(reqs) == len(delivered)
+        assert not eng.ledger._open
+        for rec in completed:
+            assert rec["outcome"] == "done"
+            assert sum(rec["phases"].values()) == pytest.approx(
+                rec["wall_s"], abs=1e-6
+            )
+            assert rec["phases"].get("prefill", 0.0) > 0.0
+            assert rec["phases"].get("decode", 0.0) > 0.0
+        # delivery records carry the lifecycle id + breakdown
+        for r in delivered:
+            assert r["req_id"].endswith(f"/{r['rid']}")
+            assert r["wall_s"] > 0.0 and r["phases"]
+
+    def test_chaos_run_closes_every_lifecycle(self, served):
+        _, (eng, reqs, _delivered) = served
+        completed = eng.ledger.completed
+        assert len(completed) == len(reqs)
+        assert not eng.ledger._open
+        outcomes = sorted(r["outcome"] for r in completed)
+        assert outcomes.count("shed") == 1
+        by_outcome = {r["outcome"]: r for r in completed}
+        shed = by_outcome["shed"]
+        assert shed["phases"].get("shed") == 0.0  # terminal marker
+        assert "decode" not in shed["phases"]
+        # the slow reader's sleep bills to stall, never decode: some
+        # completed request carries >= the injected 20ms as stall
+        assert max(
+            r["phases"].get("stall", 0.0) for r in completed
+        ) >= 0.02
+        for rec in completed:
+            assert sum(rec["phases"].values()) == pytest.approx(
+                rec["wall_s"], abs=1e-6
+            )
+
+    def test_tail_attribution_and_slo_populated(self, served):
+        (eng, _reqs, _delivered), _ = served
+        out = eng.tail_attribution()
+        assert out["dominant_phase"]
+        assert out["n_requests"] == len(eng.ledger.completed)
+        snap = eng.slo.snapshot()
+        assert snap["requests"] == len(eng.ledger.completed)
+        assert snap["burn_rate"] == 0.0  # 60s default objective on CPU
+
+    def test_gauges_and_phase_hists_populated(self, served):
+        from pytorch_distributedtraining_tpu.serve import engine as eng_mod
+
+        (eng, _reqs, _delivered), _ = served
+        for key in ("serve_queue_depth", "serve_slot_occupancy",
+                    "serve_kv_pages_free", "serve_slo_burn_rate"):
+            assert key in eng_mod.rolling_gauges
+        assert eng_mod.rolling_hists[
+            "serve_phase_decode_seconds"
+        ].count > 0
+
+
+class TestTilesLifecycle:
+    def test_tile_phases_and_completion(self):
+        from pytorch_distributedtraining_tpu.serve.tiles import (
+            SwinIRTileServer, TileRequest,
+        )
+
+        class _Identity:
+            upscale = 1
+
+            def apply(self, variables, x):
+                return x * 2.0
+
+        srv = SwinIRTileServer(
+            _Identity(), {}, tile=32, tile_batch=3, overlap=0
+        )
+        rng = np.random.default_rng(0)
+        recs = srv.run([
+            TileRequest(0, rng.random((32, 64, 3)).astype(np.float32)),
+            TileRequest(1, rng.random((32, 32, 3)).astype(np.float32)),
+        ])
+        assert len(recs) == 2
+        completed = srv.ledger.completed
+        assert len(completed) == 2 and not srv.ledger._open
+        for rec in completed:
+            assert rec["phases"].get("tile", 0.0) > 0.0
+            assert sum(rec["phases"].values()) == pytest.approx(
+                rec["wall_s"], abs=1e-6
+            )
+        # tile intervals carry batch attribution attrs
+        tile_ivals = [
+            (phase, attrs)
+            for rec in completed
+            for phase, _a, _b, attrs in rec["intervals"]
+            if phase == "tile"
+        ]
+        assert tile_ivals
+        for _phase, attrs in tile_ivals:
+            assert {"tiles", "share", "padding_fraction"} <= set(attrs)
+        assert srv.tail_attribution()["dominant_phase"]
+        assert srv.slo.snapshot()["requests"] == 2
+
+
+class TestFleetGaugePublication:
+    def test_gauges_ride_published_doc_to_monitor(self, tmp_path):
+        fleet = pytest.importorskip(
+            "pytorch_distributedtraining_tpu.observe.fleet"
+        )
+        eng_mod = pytest.importorskip(
+            "pytorch_distributedtraining_tpu.serve.engine"
+        )
+        from pytorch_distributedtraining_tpu.runtime.membership import (
+            MembershipStore,
+        )
+
+        saved = dict(eng_mod.rolling_gauges)
+        eng_mod.rolling_gauges.clear()
+        eng_mod.rolling_gauges.update({
+            "serve_queue_depth": 3.0,
+            "serve_slo_burn_rate": 1.25,
+            "serve_bogus": "not-a-number",  # filtered, not published
+        })
+        try:
+            store = MembershipStore(str(tmp_path / "m"))
+            pub = fleet.RankMetricsPublisher(store, "node0", 2)
+            assert pub.publish(force=True)
+            doc = store.read_metrics()[0]
+            assert doc["gauges"] == {
+                "serve_queue_depth": 3.0, "serve_slo_burn_rate": 1.25,
+            }
+            mon = fleet.FleetMonitor(
+                str(tmp_path / "run"), store=store, port=None,
+                interval_s=0.0,
+            )
+            mon.refresh()
+            body = mon.prometheus()
+            assert 'serve_slo_burn_rate{rank="2"} 1.25' in body
+            assert 'serve_queue_depth{rank="2"} 3' in body
+            assert "# TYPE serve_slo_burn_rate gauge" in body
+        finally:
+            eng_mod.rolling_gauges.clear()
+            eng_mod.rolling_gauges.update(saved)
