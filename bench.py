@@ -1319,11 +1319,28 @@ def _bench() -> None:
             + " ".join(f"{k}={v}({s})" for k, (v, s) in resolved.items()),
             flush=True,
         )
+    clip_norm = 0.1  # shared with the numerics block's clip_fraction
     if opt_impl == "fused":
-        tx = optim.FusedAdamW(lr=5e-4, clip_grad_norm=0.1)
+        tx = optim.FusedAdamW(lr=5e-4, clip_grad_norm=clip_norm)
     else:
-        tx = optim.adamw(lr=5e-4, clip_grad_norm=0.1)
+        tx = optim.adamw(lr=5e-4, clip_grad_norm=clip_norm)
     policy = DDP()
+    # numerics plane (observe/numerics.py): ON by default in the bench
+    # child like telemetry — the probe rides the jitted step as fused aux
+    # (no extra dispatch), refs are collected during the windows without
+    # a sync, and the host decode runs AFTER timing. Its per-step host
+    # cost is priced into the same 1% overhead gate as the spans.
+    # Explicit falsy GRAFT_NUMERICS opts out.
+    _num_env = os.environ.get("GRAFT_NUMERICS")
+    num_probe = None
+    if _num_env is None or _num_env.strip().lower() not in (
+        "", "0", "false", "off", "no"
+    ):
+        from pytorch_distributedtraining_tpu.observe.numerics import (
+            NumericsProbe,
+        )
+
+        num_probe = NumericsProbe()
 
     def loss_fn(params, batch, rng, model_state):
         lr_img, hr_img = batch
@@ -1353,7 +1370,8 @@ def _bench() -> None:
         )
 
         step = CompressedGradStep(
-            loss_fn, tx, mesh, policy, donate=True, wire=wire_fmt
+            loss_fn, tx, mesh, policy, donate=True, wire=wire_fmt,
+            numerics=num_probe,
         )
     else:
         step = TrainStep(
@@ -1362,6 +1380,7 @@ def _bench() -> None:
             state_shardings=shardings,
             extra_metrics=False,
             donate=True,
+            numerics=num_probe,
         )
     # bytes-on-wire accounting for the record: analytic per-step gradient
     # collective traffic in the chosen format vs the f32 wire it replaces
@@ -1478,6 +1497,11 @@ def _bench() -> None:
         # reports the chip's capability rather than the instantaneous
         # tunnel weather, and every window is logged for transparency.
         rates: list[float] = []
+        # device refs to each step's fused numerics aux (tiny per-leaf
+        # vectors) — an append per step, no host sync; decoded after the
+        # windows. The deep-scan arm (k>32) drops metrics by design and
+        # records no aux.
+        num_aux: list = []
         actual_steps = STEPS  # scan mode may round up to k*ceil(STEPS/k)
         if loop_impl == "scan":
             # k steps per dispatch (default: the whole window in one call).
@@ -1527,6 +1551,8 @@ def _bench() -> None:
 
                 def multi_step(s):
                     s2, m = multi_api(s, stacked)
+                    if num_probe is not None and "numerics" in m:
+                        num_aux.append(m["numerics"])  # k-stacked
                     return s2, m["loss"]
 
             else:
@@ -1587,6 +1613,8 @@ def _bench() -> None:
                     # dispatch queue throttles, the wait is real step time
                     with telemetry.span("step.dispatch", "step"):
                         state, metrics = step(state, b)
+                    if num_probe is not None and "numerics" in metrics:
+                        num_aux.append(metrics["numerics"])
                     n_steps += 1
                 _sync(metrics["loss"])
                 dt = time.perf_counter() - t0
@@ -1607,6 +1635,8 @@ def _bench() -> None:
                 t0 = time.perf_counter()
                 for _ in range(STEPS):
                     state, metrics = step(state, batch)
+                    if num_probe is not None and "numerics" in metrics:
+                        num_aux.append(metrics["numerics"])
                 _sync(metrics["loss"])
                 dt = time.perf_counter() - t0
                 rates.append(BATCH * STEPS / dt)
@@ -1659,6 +1689,83 @@ def _bench() -> None:
         best = rates.index(img_per_sec)
         f = overlap_fracs[best]
         overlap_fraction = None if f is None else round(f, 4)
+    # Numerics decode (untimed): walk the aux refs the windows collected,
+    # name any non-finite offender, feed the divergence watchdog, and
+    # summarize update health. The per-observe host cost measured here is
+    # what a training loop would pay each step — it folds into the same
+    # 1% telemetry-overhead gate below (priced, not assumed free).
+    step_time_best = BATCH / img_per_sec  # best window, per step
+    numerics_block = None
+    numerics_overhead_fraction = None
+    if num_probe is not None and num_aux:
+        from pytorch_distributedtraining_tpu.observe import (
+            numerics as obs_num,
+        )
+
+        num_watchdog = obs_num.watchdog_from_env()
+        gnorms: list[float] = []
+        nonfinite_steps = 0
+        first_verdict = None
+        t_n0 = time.perf_counter()
+        for i, aux in enumerate(num_aux):
+            s = num_probe.observe(aux, step=i, watchdog=num_watchdog)
+            gnorms.append(s["grad_norm"])
+            nonfinite_steps += bool(s["nonfinite"])
+            if first_verdict is None and s.get("verdict"):
+                first_verdict = s["verdict"]
+        per_observe_s = (time.perf_counter() - t_n0) / len(num_aux)
+        observes_per_step = len(num_aux) / max(
+            1, len(rates) * actual_steps
+        )
+        numerics_overhead_fraction = round(
+            per_observe_s * observes_per_step
+            / max(step_time_best, 1e-9),
+            6,
+        )
+        g = np.asarray(gnorms, dtype=np.float64)
+        finite_g = g[np.isfinite(g)]
+        numerics_block = {
+            "steps_observed": len(num_aux),
+            "nonfinite_steps": nonfinite_steps,
+            "blame": obs_num.runtime_stats["last_nonfinite"],
+            "grad_norm_p50": (
+                round(float(np.percentile(finite_g, 50)), 6)
+                if finite_g.size else None
+            ),
+            "grad_norm_p95": (
+                round(float(np.percentile(finite_g, 95)), 6)
+                if finite_g.size else None
+            ),
+            "grad_norm_max": (
+                round(float(finite_g.max()), 6) if finite_g.size else None
+            ),
+            # pre-clip norms: the fraction of steps the clip engaged
+            "clip_fraction": (
+                round(float((finite_g > clip_norm).mean()), 4)
+                if finite_g.size else None
+            ),
+            "watchdog_verdict": (
+                {
+                    k: first_verdict[k]
+                    for k in ("kind", "step", "action", "detail")
+                    if k in first_verdict
+                }
+                if first_verdict else None
+            ),
+            "per_observe_us": round(per_observe_s * 1e6, 1),
+            "overhead_fraction": numerics_overhead_fraction,
+        }
+        for k in (
+            "fp8_amax_saturation", "fp8_underflow_frac",
+            "wire_residual_norm", "wire_residual_max",
+        ):
+            if k in obs_num.rolling_gauges:
+                numerics_block[k] = round(
+                    float(obs_num.rolling_gauges[k]), 6
+                )
+        print(
+            "# child: numerics " + json.dumps(numerics_block), flush=True
+        )
     # Goodput/MFU ledger (untimed): classify the measurement interval's
     # wall clock from the spans recorded during the windows, and report
     # utilization against the analytic per-image train FLOPs — the
@@ -1682,7 +1789,6 @@ def _bench() -> None:
         gf = ledger.goodput_fraction()
         goodput_fraction = None if gf is None else round(gf, 4)
         time_breakdown = ledger.time_breakdown()
-        step_time_best = BATCH / img_per_sec  # best window, per step
         dev0 = jax.devices()[0]
         try:
             flops_per_step = model_train_flops(model, BATCH, (PATCH, PATCH))
@@ -1710,8 +1816,12 @@ def _bench() -> None:
                 pass
         per_span_s = (time.perf_counter() - t_p) / probe_n
         spans_per_step = n_window_spans / max(1, len(rates) * actual_steps)
+        # the numerics decode is instrumentation a training loop pays per
+        # step too — it shares the 1% budget with the spans
         telemetry_overhead_fraction = round(
-            per_span_s * spans_per_step / max(step_time_best, 1e-9), 6
+            per_span_s * spans_per_step / max(step_time_best, 1e-9)
+            + (numerics_overhead_fraction or 0.0),
+            6,
         )
         print(
             "# child: telemetry "
@@ -1768,13 +1878,17 @@ def _bench() -> None:
             # no "# " prefix: _informative_tail must pick THIS line as
             # the cause in the parent's error record
             print(
-                f"TELEMETRY OVERHEAD: span cost "
+                f"TELEMETRY OVERHEAD: instrumentation cost "
                 f"{telemetry_overhead_fraction:.2%} of the steady-state "
                 f"step ({per_span_s * 1e6:.1f} us/span x "
-                f"{spans_per_step:.2f} spans/step vs "
-                f"{step_time_best * 1e3:.3f} ms/step) exceeds the 1% "
-                "budget — the instrument is distorting the measurement, "
-                "refusing to publish",
+                f"{spans_per_step:.2f} spans/step"
+                + (
+                    f" + numerics {numerics_overhead_fraction:.2%}"
+                    if numerics_overhead_fraction else ""
+                )
+                + f" vs {step_time_best * 1e3:.3f} ms/step) exceeds the "
+                "1% budget — the instrument is distorting the "
+                "measurement, refusing to publish",
                 flush=True,
             )
             sys.exit(9)
@@ -1985,6 +2099,7 @@ def _bench() -> None:
                 "goodput_fraction": goodput_fraction,
                 "time_breakdown": time_breakdown,
                 "telemetry_overhead_fraction": telemetry_overhead_fraction,
+                "numerics": numerics_block,
                 "fleet": fleet_summary,
                 "compile_cache": compile_cache,
                 "static_findings": static_findings,

@@ -85,6 +85,10 @@ STAGES = [
     ("slo", "serve request-lifecycle rollup: per-request phase rows + "
             "tail attribution (trace_summary.py over the graft-serve "
             "lanes serve_bench exports)"),
+    ("numerics", "numerics observability plane: grad-norm quantiles, "
+                 "clip_fraction, non-finite blame + watchdog verdict from "
+                 "the bench record's numerics block (bench.py fused probe; "
+                 "trace_summary.py rolls up the numerics.* instants)"),
     ("ladder", "five-config ladder (ladder.py --all)"),
 ]
 
@@ -113,6 +117,8 @@ ARM_KNOBS = {
     "grow": "GRAFT_BENCH_RECOVERY=1 GRAFT_BENCH_RECOVERY_GROW=1",
     # serving SLO arm (summary record; continuous-vs-static lives inside)
     "serve": "GRAFT_BENCH_SERVE=1",
+    # numerics plane arm (health record, never a throughput winner)
+    "numerics": "GRAFT_NUMERICS=1 GRAFT_NUMERICS_ACTION=halt",
 }
 
 

@@ -177,6 +177,48 @@ def telemetry_rollup(events, top: int):
     return rows, total
 
 
+def numerics_rollup(events):
+    """Summary row for ``numerics.*`` instants (observe/numerics.py).
+
+    The generic instant counter above already tallies them by name; this
+    keeps the plane's payloads — which leaf drew blame, what kind of
+    divergence tripped, where a rollback landed — which a count-by-name
+    row flattens away. Returns None when the trace carries no numerics
+    events at all, so clean runs print nothing extra.
+    """
+    by_name = collections.Counter()
+    blamed = collections.Counter()
+    kinds = collections.Counter()
+    rollbacks = []
+    for e in events:
+        if e.get("ph") != "i":
+            continue
+        name = e.get("name", "")
+        if not name.startswith("numerics."):
+            continue
+        by_name[name] += 1
+        args = e.get("args", {})
+        if name == "numerics.nonfinite" and args.get("leaf"):
+            blamed[args["leaf"]] += 1
+        elif name == "numerics.divergence" and args.get("kind"):
+            kinds[args["kind"]] += 1
+        elif name == "numerics.rollback":
+            rollbacks.append({
+                "tripped_step": args.get("tripped_step"),
+                "restored_step": args.get("restored_step"),
+            })
+    if not by_name:
+        return None
+    row = {
+        "numerics_instants": dict(by_name.most_common()),
+        "nonfinite_blame": dict(blamed.most_common()),
+        "divergence_kinds": dict(kinds.most_common()),
+    }
+    if rollbacks:
+        row["rollbacks"] = rollbacks
+    return row
+
+
 def serve_rollup(events):
     """Per-request rows from graft-serve lanes (observe/slo.py export).
 
@@ -298,6 +340,9 @@ def main(argv=None):
                 }))
         for r in rows:
             print(json.dumps(r))
+        num_row = numerics_rollup(tel_events)
+        if num_row is not None:
+            print(json.dumps(num_row))
     if not (tel_events or serve_events) or any(
         e.get("ph") == "X" for e in op_events
     ):

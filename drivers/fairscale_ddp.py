@@ -159,12 +159,18 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
     if wire_spec is None:
         wire_spec = os.environ.get("GRAFT_WIRE")
     wire = wire_format(wire_spec)
+    # --numerics/$GRAFT_NUMERICS: fuse the numerics probe into the jitted
+    # step and run the host-side divergence watchdog over its aux
+    from pytorch_distributedtraining_tpu.observe import numerics as obs_num
+
+    probe = obs_num.probe_from_env()
+    watchdog = obs_num.watchdog_from_env() if probe is not None else None
     if wire is not None and pp == 1:
         # MeshSpec.zero() puts every device on the sharded-DP axis, so
         # the quantized hop IS the fsdp axis here
         step = CompressedGradStep(
             loss_fn, tx, mesh, ZeRO2(remat=remat),
-            axis_name="fsdp", wire=wire,
+            axis_name="fsdp", wire=wire, numerics=probe,
         )
         cost = step.wire_cost(state.params)
         print(f"===> Quantized wire {cost['wire_format']}: "
@@ -177,7 +183,8 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
             print("--wire ignored under --pp (the pipelined mesh's "
                   "collectives re-home activations, not gradients)")
         step = TrainStep(
-            loss_fn, tx, mesh, ZeRO2(remat=remat), state_shardings=shardings
+            loss_fn, tx, mesh, ZeRO2(remat=remat), state_shardings=shardings,
+            numerics=probe,
         )
 
     # --analyze/$GRAFT_ANALYZE: graftcheck the step before the first
@@ -237,7 +244,33 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
                     continue
                 state, metrics = step(state, batch)
                 loss = metrics["loss"]
-                if mgr is not None:
+                step_clean = True
+                if probe is not None and "numerics" in metrics:
+                    summary = probe.observe(
+                        metrics["numerics"], step=int(state.step),
+                        loss=metrics.get("loss"), watchdog=watchdog,
+                    )
+                    # a non-finite step poisoned the post-update params:
+                    # checkpointing it would make the rollback target
+                    # itself divergent once the watchdog's patience runs
+                    # out a step or two later
+                    step_clean = not summary.get("nonfinite")
+                    verdict = summary.get("verdict")
+                    if verdict is not None:
+                        # rollback restores the last committed checkpoint
+                        # and resumes the schedule from there; degrade
+                        # flips $GRAFT_WIRE to fp32 for later rebuilds;
+                        # halt raises NumericsDivergence out of the loop
+                        rolled = watchdog.apply_action(
+                            verdict, manager=mgr, template=state,
+                        )
+                        if rolled is not None:
+                            rolled_step, state = rolled
+                            print("===> numerics watchdog "
+                                  f"{verdict['kind']} @ step "
+                                  f"{verdict['step']}: rolled back to "
+                                  f"committed step {rolled_step}")
+                if mgr is not None and step_clean:
                     mgr.maybe_save(int(state.step), state)
                 if iteration % 25 == 0:
                     print(loss)
@@ -317,12 +350,26 @@ def main(argv=None):
                              "--trace writes under the run dir, --trace DIR "
                              "writes there (env twin $GRAFT_TRACE; "
                              "$GRAFT_TELEMETRY=0 force-disables)")
+    parser.add_argument("--numerics", type=str, nargs="?", const="halt",
+                        default=None,
+                        choices=[None, "halt", "rollback", "degrade"],
+                        help="enable the numerics observability plane: fused "
+                             "on-device probes (non-finite blame, grad/param "
+                             "norms, fp8/wire health) plus the divergence "
+                             "watchdog. The value is the watchdog action — "
+                             "rollback pairs with --ckpt to restore the last "
+                             "committed step (bare --numerics = halt; env "
+                             "twins $GRAFT_NUMERICS / $GRAFT_NUMERICS_ACTION)")
     opt = parser.parse_args(argv)
 
     if opt.trace is not None:
         os.environ.setdefault("GRAFT_TELEMETRY", "1")
         if opt.trace:
             os.environ["GRAFT_TRACE"] = opt.trace
+
+    if opt.numerics:
+        os.environ["GRAFT_NUMERICS"] = "1"
+        os.environ["GRAFT_NUMERICS_ACTION"] = opt.numerics
 
     # GRAFT_PLATFORM=cpu forces the backend (see runtime.dist docstring:
     # some images re-latch JAX_PLATFORMS before user code runs)

@@ -265,6 +265,15 @@ def build_parser():
                              "--trace writes under the run dir, --trace DIR "
                              "writes there (env twin $GRAFT_TRACE; "
                              "$GRAFT_TELEMETRY=0 force-disables)")
+    parser.add_argument("--numerics", type=str, nargs="?", const="halt",
+                        default=None,
+                        choices=[None, "halt", "rollback", "degrade"],
+                        help="enable the numerics observability plane: fused "
+                             "on-device probes (non-finite blame, grad/param "
+                             "norms, fp8/wire health) plus the divergence "
+                             "watchdog. The value is the watchdog action "
+                             "(bare --numerics = halt; env twins "
+                             "$GRAFT_NUMERICS / $GRAFT_NUMERICS_ACTION)")
     return parser
 
 
@@ -336,6 +345,14 @@ def main(argv=None):
     if opt.fp8:
         os.environ["GRAFT_FP8"] = opt.fp8
         print(f"===> fp8 matmul mode={opt.fp8}")
+
+    # --numerics threads the numerics plane through its env twins: the
+    # facade builds the probe + watchdog at construction; the value picked
+    # here is the watchdog action policy
+    if opt.numerics:
+        os.environ["GRAFT_NUMERICS"] = "1"
+        os.environ["GRAFT_NUMERICS_ACTION"] = opt.numerics
+        print(f"===> numerics plane on, watchdog action={opt.numerics}")
 
     # --trace threads telemetry through its env twins: the facade enables
     # the tracer at construction; export happens after the epoch loop

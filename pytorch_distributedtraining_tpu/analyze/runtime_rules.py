@@ -251,6 +251,77 @@ def serve_slo_burn(ctx):
         )
 
 
+def _numerics_stats():
+    """observe.numerics.runtime_stats via sys.modules — never imported
+    (stdlib-only module, but importing it here would defeat the
+    'a live probe IS the signal' contract: stats only exist when the
+    training process actually ran the numerics plane)."""
+    nm = sys.modules.get(
+        "pytorch_distributedtraining_tpu.observe.numerics"
+    )
+    return getattr(nm, "runtime_stats", None)
+
+
+@rule(
+    "numerics-nonfinite",
+    "runtime",
+    "the numerics probe observed non-finite gradients, with blame",
+)
+def numerics_nonfinite(ctx):
+    stats = _numerics_stats()
+    if not stats or not stats.get("nonfinite_steps_total"):
+        return
+    blame = stats.get("last_nonfinite") or {}
+    where = blame.get("leaf", "<unknown leaf>")
+    layer = blame.get("layer")
+    if layer is not None and layer >= 0:
+        where += f" (layer {layer})"
+    yield Finding(
+        "numerics-nonfinite",
+        Severity.ERROR,
+        "runtime:numerics",
+        f"{stats['nonfinite_steps_total']} step(s) produced non-finite "
+        f"gradients; first offender of the latest: {where} at step "
+        f"{blame.get('step')}. Every poisoned step trains on garbage — "
+        "roll back to the last committed checkpoint "
+        "(GRAFT_NUMERICS_ACTION=rollback), or bisect the leaf (lr too "
+        "hot, fp8 overflow, quantized wire) before resuming",
+        evidence=(
+            f"nonfinite_steps_total={stats['nonfinite_steps_total']} "
+            f"last_nonfinite={blame!r} "
+            f"grad_norm_last={stats.get('grad_norm_last')}"
+        ),
+    )
+
+
+@rule(
+    "numerics-divergence",
+    "runtime",
+    "the numerics watchdog tripped on a confirmed divergence",
+)
+def numerics_divergence(ctx):
+    stats = _numerics_stats()
+    if not stats:
+        return
+    for v in stats.get("verdicts") or []:
+        yield Finding(
+            "numerics-divergence",
+            Severity.WARN,
+            "runtime:numerics",
+            f"watchdog tripped: {v.get('kind')} at step {v.get('step')} "
+            f"(action={v.get('action')}) — {v.get('detail')}. A trip "
+            "that rolled back cleanly is survivable but the trajectory "
+            "lost the rolled-back window; repeated trips mean the run "
+            "is unstable (lower the lr, widen the clip, or degrade the "
+            "quantized wire)",
+            evidence=(
+                f"kind={v.get('kind')} step={v.get('step')} "
+                f"action={v.get('action')}"
+                + (f" z={v.get('z')}" if v.get("z") is not None else "")
+            ),
+        )
+
+
 @rule(
     "bench-regression",
     "runtime",

@@ -877,6 +877,11 @@ class CheckpointManager:
         anywhere = self._preempted_anywhere()
         if scheduled or anywhere:
             self._preempted.clear()
+            if step in self.all_steps():
+                # a rollback resume re-enters the step it just restored:
+                # that checkpoint is already durable, and a second write
+                # would collide with the committed dir at rename time
+                return None
             if anywhere:
                 runtime_stats["forced_saves"] += 1
                 telemetry.instant(

@@ -23,11 +23,23 @@ def mse(outputs, targets):
     return mse_loss(jnp.asarray(outputs), jnp.asarray(targets))
 
 
+# MSE floor for psnr: exact-match outputs would otherwise produce
+# log10(x/0) = inf, and a non-finite eval scalar poisons every sink it
+# reaches (JSONL "NaN"/"Infinity" breaks json.loads consumers). 1e-10
+# caps PSNR at a finite 100 dB for data_range=1 — far above any real
+# reconstruction, clearly a sentinel, and large enough that f32 MSE
+# rounding noise (~1e-14 on matching images) also lands on the cap
+# instead of jittering around it.
+PSNR_MSE_EPS = 1e-10
+
+
 def psnr(outputs, targets, data_range: float = 1.0):
     """Peak signal-to-noise ratio in dB (data_range=1. per the reference's
-    img_range)."""
+    img_range). Finite by construction: MSE is floored at
+    :data:`PSNR_MSE_EPS`, so exact-match outputs report the 100 dB cap
+    rather than ``inf`` (pinned by ``tests/test_numerics.py``)."""
     err = mse(outputs, targets)
-    err = jnp.maximum(err, jnp.finfo(jnp.float32).tiny)  # inf-guard
+    err = jnp.maximum(err, PSNR_MSE_EPS)
     return 10.0 * jnp.log10(data_range**2 / err)
 
 

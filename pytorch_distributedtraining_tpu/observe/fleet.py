@@ -605,6 +605,21 @@ def _serve_rolling_gauges() -> dict:
     return out
 
 
+def _numerics_rolling_gauges() -> dict:
+    """The training-numerics plane's health gauges (grad_norm,
+    nonfinite_steps_total, fp8_amax_saturation, update ratios, wire
+    residual norms — observe/numerics.py) — sys.modules, never imported,
+    so a run without the numerics plane publishes nothing."""
+    out: dict = {}
+    nm = sys.modules.get(
+        "pytorch_distributedtraining_tpu.observe.numerics"
+    )
+    for name, v in (getattr(nm, "rolling_gauges", None) or {}).items():
+        if isinstance(v, (int, float)):
+            out[f"numerics_{name}"] = float(v)
+    return out
+
+
 class RankMetricsPublisher:
     """One rank's metric publication into the membership store.
 
@@ -661,6 +676,7 @@ class RankMetricsPublisher:
         hists.update(_serve_rolling_hists())
         doc: dict = {"hists": {k: h.to_dict() for k, h in hists.items()}}
         gauges = _serve_rolling_gauges()
+        gauges.update(_numerics_rolling_gauges())
         if gauges:
             doc["gauges"] = gauges
         if self.offset is not None:

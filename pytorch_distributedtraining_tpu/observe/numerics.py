@@ -1,0 +1,656 @@
+"""Training-numerics observability: blame attribution + divergence watchdog.
+
+The telemetry stack answers "where did the time go"; this module answers
+"are the numbers still healthy" — the question that actually kills long
+runs. Two halves:
+
+- **On-device probes** (:class:`NumericsProbe`): ONE fused auxiliary
+  computation appended to the jitted step — no extra dispatch, no device
+  sync. Per-leaf finite masks reduce to a compact int vector so the host
+  can name the exact param/grad leaf (and, via the scan-stacked layer
+  axis, the layer index) that first went NaN/Inf; global grad/param
+  norms and per-leaf update-to-weight ratios summarize update health;
+  fp8 amax-history saturation + underflow gauges read the "fp8" variable
+  collection (`precision.Fp8DotGeneral`); error-feedback residual norms
+  track quantized-wire health (`parallel/compressed.py` — a growing
+  residual means the quantizer is diverging, not converging).
+- **Host-side watchdog** (:class:`NumericsWatchdog`): consumes the probe
+  outputs plus the loss series through a rolling robust-z (MAD) anomaly
+  detector — the same statistic the straggler flagger and the perf
+  sentry use — and on a confirmed divergence takes a policy action:
+  ``halt`` (raise), ``rollback`` (restore the last committed portable
+  checkpoint via ``CheckpointManager.restore_latest`` and re-arm), or
+  ``degrade`` (dial ``GRAFT_WIRE`` back to the f32 wire for the restart).
+
+Module import is stdlib-only by contract (jax is imported lazily inside
+the traced half): the jax-free graftcheck runtime plane reads
+``runtime_stats`` via ``sys.modules``, the fleet publisher reads
+``rolling_gauges`` the same way, and the crash flight recorder embeds
+``snapshot()`` — none of them may pull a backend in.
+
+Env knobs (resolved by the facade / drivers / bench):
+
+- ``GRAFT_NUMERICS``           enable the probes (TPUConfig.numerics twin)
+- ``GRAFT_NUMERICS_ACTION``    halt | rollback | degrade (watchdog policy)
+- ``GRAFT_NUMERICS_INJECT``    ``<leaf-substring>@<step>`` — deterministic
+  NaN injection into one named grad leaf at one step (the numerics twin
+  of a resilience fault plan; drills and the acceptance test use it)
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import os
+import sys
+import time
+
+from . import trace as _trace
+
+__all__ = [
+    "NumericsProbe",
+    "NumericsWatchdog",
+    "NumericsDivergence",
+    "parse_inject_spec",
+    "snapshot",
+    "reset",
+    "runtime_stats",
+    "rolling_gauges",
+    "ACTIONS",
+]
+
+ACTIONS = ("halt", "rollback", "degrade")
+
+# fp8 e4m3 finite max; the probe's default saturation denominator when the
+# caller doesn't pass the active mode's max (precision._fp8_max)
+FP8_E4M3_MAX = 448.0
+# smallest normal of e4m3 (2**-6): amax histories that ARE populated but
+# sit below this mean the scaled tensor is flushing to zero — underflow
+FP8_TINY = 2.0**-6
+
+# read by analyze/runtime_rules.py via sys.modules — never imported there
+runtime_stats: dict = {
+    "enabled": False,
+    "action": None,
+    "steps_observed": 0,
+    "nonfinite_steps_total": 0,
+    "last_nonfinite": None,  # {"step", "leaf", "layer"}
+    "grad_norm_last": None,
+    "verdicts": [],  # watchdog trip records, newest last
+    "degraded_wire": False,
+}
+
+# read by observe/fleet.py's RankMetricsPublisher via sys.modules; names
+# are the Prometheus gauge names (the monitor adds the rank label)
+rolling_gauges: dict = {}
+
+
+def reset() -> None:
+    """Restore the module gauges to their import-time state (the stats
+    are process-global on purpose — every consumer reads them through
+    ``sys.modules`` — so tests and fresh runs re-arm them explicitly)."""
+    runtime_stats.update(
+        enabled=False,
+        action=None,
+        steps_observed=0,
+        nonfinite_steps_total=0,
+        last_nonfinite=None,
+        grad_norm_last=None,
+        verdicts=[],
+        degraded_wire=False,
+    )
+    rolling_gauges.clear()
+
+
+def snapshot() -> dict:
+    """Numerics state for the crash flight recorder: compact, json-safe."""
+    return {
+        "steps_observed": runtime_stats["steps_observed"],
+        "nonfinite_steps_total": runtime_stats["nonfinite_steps_total"],
+        "last_nonfinite": runtime_stats["last_nonfinite"],
+        "grad_norm_last": runtime_stats["grad_norm_last"],
+        "verdicts": list(runtime_stats["verdicts"])[-4:],
+        "gauges": {
+            k: v for k, v in rolling_gauges.items()
+            if isinstance(v, (int, float))
+        },
+    }
+
+
+def parse_inject_spec(spec: str | None) -> tuple[str, int] | None:
+    """``"<leaf-substring>@<step>"`` -> ``(pattern, step)`` or None."""
+    if not spec:
+        return None
+    pat, sep, at = str(spec).rpartition("@")
+    if not sep or not pat:
+        raise ValueError(
+            f"GRAFT_NUMERICS_INJECT spec {spec!r}: expected "
+            "'<leaf-substring>@<step>' (e.g. 'dense2/kernel@5')"
+        )
+    return pat, int(at)
+
+
+def _np():
+    import numpy as np
+
+    return np
+
+
+def _path_str(path) -> str:
+    """Tree path -> ``dense2/kernel`` spelling (keystr renders
+    ``['dense2']['kernel']``, which no one types into an inject spec)."""
+    parts = []
+    for k in path:
+        for attr in ("key", "name", "idx"):
+            v = getattr(k, attr, None)
+            if v is not None:
+                parts.append(str(v))
+                break
+        else:
+            parts.append(str(k).strip("[].'\""))
+    return "/".join(parts)
+
+
+class NumericsProbe:
+    """Builds the fused on-device numerics aux and decodes it host-side.
+
+    One instance per jitted step. ``aux()`` runs INSIDE the traced step
+    (it records the grad tree's leaf paths as a side effect of tracing,
+    so the host can translate a device-side leaf index back to a name);
+    ``observe()`` runs on the host, at whatever cadence the caller can
+    afford a device→host fetch, and feeds gauges / trace instants /
+    runtime stats / an optional watchdog.
+    """
+
+    def __init__(
+        self,
+        *,
+        fp8_max: float = FP8_E4M3_MAX,
+        fp8_tiny: float = FP8_TINY,
+        inject: str | None = None,
+    ):
+        self.fp8_max = float(fp8_max)
+        self.fp8_tiny = float(fp8_tiny)
+        self.inject_spec = parse_inject_spec(
+            inject
+            if inject is not None
+            else os.environ.get("GRAFT_NUMERICS_INJECT")
+        )
+        self.leaf_paths: list[str] = []  # set at trace time by aux()
+        runtime_stats["enabled"] = True
+
+    # -- the traced half (in-jit; no host sync) -------------------------
+
+    def inject(self, grads, step):
+        """Deterministic NaN injection into the named leaf at one step.
+
+        The numerics twin of a resilience fault plan: branchless
+        ``jnp.where`` on the traced step counter, so the poisoned step is
+        decided at trace time by data, not by a host branch — the same
+        compiled program runs clean and poisoned steps.
+        """
+        if self.inject_spec is None:
+            return grads
+        import jax
+        import jax.numpy as jnp
+
+        pat, at = self.inject_spec
+
+        def poison(path, g):
+            if pat not in _path_str(path):
+                return g
+            return jnp.where(
+                jnp.equal(step, at), jnp.full_like(g, jnp.nan), g
+            )
+
+        return jax.tree_util.tree_map_with_path(poison, grads)
+
+    def aux(
+        self,
+        grads,
+        *,
+        params=None,
+        updates=None,
+        model_state=None,
+        residuals=None,
+        grad_norm=None,
+    ) -> dict:
+        """The fused aux dict, appended to the step's metrics.
+
+        Everything returned is a scalar or an O(n_leaves) int/float
+        vector — compact enough that fetching it costs what fetching the
+        loss costs. ``grad_norm`` accepts a pre-computed norm (the
+        recorded-clip chain state or FusedAdamW's fused gnorm) so the
+        probe and clipping never compute it twice.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        flat = jax.tree_util.tree_flatten_with_path(grads)[0]
+        # trace-time side effect: the host-side decoder's index->name map
+        self.leaf_paths = [_path_str(p) for p, _ in flat]
+        leaves = [v for _, v in flat]
+
+        finite = jnp.stack([jnp.all(jnp.isfinite(v)) for v in leaves])
+        bad = jnp.logical_not(finite)
+        first_bad = jnp.where(
+            jnp.any(bad), jnp.argmax(bad), -1
+        ).astype(jnp.int32)
+        # per-leaf first offending index along the leading (scan-stacked
+        # layer) axis; -1 = the whole leaf is finite or has no layer axis
+        layer = []
+        for v in leaves:
+            if v.ndim >= 2 and v.shape[0] > 1:
+                row_bad = jnp.logical_not(
+                    jnp.all(
+                        jnp.isfinite(v.reshape(v.shape[0], -1)), axis=1
+                    )
+                )
+                layer.append(
+                    jnp.where(
+                        jnp.any(row_bad), jnp.argmax(row_bad), -1
+                    ).astype(jnp.int32)
+                )
+            else:
+                layer.append(jnp.int32(-1))
+
+        if grad_norm is None:
+            grad_norm = jnp.sqrt(
+                sum(
+                    jnp.sum(jnp.square(v.astype(jnp.float32)))
+                    for v in leaves
+                )
+            )
+        out = {
+            "finite_mask": finite,
+            "first_bad_leaf": first_bad,
+            "bad_layer": jnp.stack(layer),
+            "grad_norm": jnp.asarray(grad_norm, jnp.float32),
+        }
+
+        if params is not None:
+            pleaves = [
+                v.astype(jnp.float32) for v in jax.tree.leaves(params)
+            ]
+            out["param_norm"] = jnp.sqrt(
+                sum(jnp.sum(jnp.square(v)) for v in pleaves)
+            )
+        if updates is not None and params is not None:
+            # per-leaf ||update|| / ||param||: the classic update-health
+            # statistic — ~1e-3 is healthy, ~1 means the step is rewriting
+            # the weights, ~0 means the leaf is frozen
+            uleaves = jax.tree.leaves(updates)
+            ratios = []
+            for u, p in zip(uleaves, pleaves):
+                un = jnp.sqrt(jnp.sum(jnp.square(u.astype(jnp.float32))))
+                pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+                # zero-norm leaves (fresh zero-init biases) report 0, not
+                # an astronomic ratio — the gauge tracks rewrite pressure
+                # on weights that exist
+                ratios.append(jnp.where(pn > 0.0, un / (pn + 1e-12), 0.0))
+            out["update_ratio"] = jnp.stack(ratios)
+
+        fp8 = self._fp8_collection(model_state)
+        if fp8 is not None:
+            hist = [
+                v.astype(jnp.float32).reshape(-1)
+                for v in jax.tree.leaves(fp8)
+            ]
+            allh = jnp.concatenate(hist)
+            amax = jnp.max(allh)
+            seen = allh > 0.0  # unwritten history slots stay exactly 0
+            n_seen = jnp.maximum(jnp.sum(seen), 1)
+            out["fp8_amax_max"] = amax
+            out["fp8_amax_saturation"] = amax / self.fp8_max
+            out["fp8_underflow_frac"] = (
+                jnp.sum(jnp.logical_and(seen, allh < self.fp8_tiny))
+                / n_seen
+            )
+
+        if residuals is not None:
+            rleaves = [
+                v.astype(jnp.float32) for v in jax.tree.leaves(residuals)
+            ]
+            out["wire_residual_norm"] = jnp.sqrt(
+                sum(jnp.sum(jnp.square(v)) for v in rleaves)
+            )
+            out["wire_residual_max"] = jnp.max(
+                jnp.stack([jnp.max(jnp.abs(v)) for v in rleaves])
+            )
+        return out
+
+    @staticmethod
+    def _fp8_collection(model_state):
+        if model_state is None:
+            return None
+        try:
+            coll = model_state["fp8"]
+        except (KeyError, TypeError, IndexError):
+            return None
+        return coll if coll else None
+
+    # -- the host half (fetches; call at an affordable cadence) ---------
+
+    def leaf_name(self, idx: int) -> str:
+        if 0 <= idx < len(self.leaf_paths):
+            return self.leaf_paths[idx]
+        return f"<leaf {idx}>"
+
+    def observe(
+        self,
+        aux: dict,
+        *,
+        step: int | None = None,
+        loss=None,
+        watchdog: "NumericsWatchdog | None" = None,
+    ) -> dict:
+        """Decode one step's aux on the host: name blame, update gauges,
+        emit ``numerics.*`` instants, feed the watchdog.
+
+        Accepts stacked aux (MultiStep scans k steps into one dispatch:
+        a leading axis on every field) — the decode reduces it to the
+        worst step in the window. Returns a json-safe summary; if a
+        watchdog trips, its verdict rides under ``"verdict"``.
+        """
+        np = _np()
+
+        def host(v):
+            return np.asarray(v)
+
+        finite = host(aux["finite_mask"])
+        first_bad = host(aux["first_bad_leaf"]).reshape(-1)
+        bad_layer = host(aux["bad_layer"])
+        if finite.ndim > 1:  # k-stacked window: worst step wins
+            finite = finite.all(axis=tuple(range(finite.ndim - 1)))
+            bad_layer = bad_layer.reshape(-1, bad_layer.shape[-1]).max(0)
+        first_idx = int(next((i for i in first_bad if i >= 0), -1))
+        gnorm = float(np.max(host(aux["grad_norm"])))
+        loss_val = None if loss is None else float(np.ravel(host(loss))[-1])
+
+        nonfinite = first_idx >= 0 or not bool(finite.all())
+        blame = None
+        if nonfinite:
+            idx = first_idx if first_idx >= 0 else int(
+                np.argmax(~finite)
+            )
+            layer = int(bad_layer[idx]) if idx < bad_layer.size else -1
+            blame = {
+                "leaf": self.leaf_name(idx),
+                "layer": layer,
+                "step": step,
+            }
+
+        runtime_stats["steps_observed"] += 1
+        runtime_stats["grad_norm_last"] = gnorm
+        rolling_gauges["grad_norm"] = gnorm
+        if loss_val is not None and math.isfinite(loss_val):
+            rolling_gauges["loss"] = loss_val
+        if "param_norm" in aux:
+            rolling_gauges["param_norm"] = float(
+                np.max(host(aux["param_norm"]))
+            )
+        if "update_ratio" in aux:
+            ur = host(aux["update_ratio"])
+            rolling_gauges["update_ratio_max"] = float(ur.max())
+        for k in ("fp8_amax_saturation", "fp8_underflow_frac"):
+            if k in aux:
+                rolling_gauges[k] = float(np.max(host(aux[k])))
+        for k in ("wire_residual_norm", "wire_residual_max"):
+            if k in aux:
+                rolling_gauges[k] = float(np.max(host(aux[k])))
+
+        if nonfinite:
+            runtime_stats["nonfinite_steps_total"] += 1
+            runtime_stats["last_nonfinite"] = blame
+            _trace.instant(
+                "numerics.nonfinite",
+                "fault",
+                step=step,
+                leaf=blame["leaf"],
+                layer=blame["layer"],
+            )
+        rolling_gauges["nonfinite_steps_total"] = float(
+            runtime_stats["nonfinite_steps_total"]
+        )
+
+        summary = {
+            "step": step,
+            "nonfinite": nonfinite,
+            "blame": blame,
+            "grad_norm": gnorm,
+            "loss": loss_val,
+        }
+        for k in (
+            "param_norm", "update_ratio_max", "fp8_amax_saturation",
+            "fp8_underflow_frac", "wire_residual_norm",
+        ):
+            if k in rolling_gauges:
+                summary[k] = rolling_gauges[k]
+        if watchdog is not None:
+            summary["verdict"] = watchdog.observe(
+                step=step,
+                loss=loss_val,
+                grad_norm=gnorm,
+                nonfinite=nonfinite,
+                blame=blame,
+            )
+        return summary
+
+
+class NumericsDivergence(RuntimeError):
+    """Raised by the ``halt`` policy (and by ``rollback`` with no
+    checkpoint manager to roll back through)."""
+
+    def __init__(self, verdict: dict):
+        self.verdict = verdict
+        super().__init__(
+            f"numerics watchdog: {verdict.get('kind')} at step "
+            f"{verdict.get('step')} (action={verdict.get('action')}): "
+            f"{verdict.get('detail')}"
+        )
+
+
+def _robust_z(value: float, history) -> float:
+    """Modified z-score vs the rolling window — 0.6745·(x−median)/MAD,
+    the same statistic goodput's straggler flagger and the fleet perf
+    sentry use. Degenerate (tiny / zero-MAD) windows return 0."""
+    vals = sorted(history)
+    n = len(vals)
+    if n < 3:
+        return 0.0
+    med = vals[n // 2]
+    mad = sorted(abs(v - med) for v in vals)[n // 2]
+    if mad <= 0.0:
+        return 0.0
+    return 0.6745 * (value - med) / mad
+
+
+class NumericsWatchdog:
+    """Rolling robust-z divergence detector with a policy action.
+
+    Trips on: a **sustained non-finite** streak (``nonfinite_patience``
+    consecutive poisoned steps — a single fp16-overflow skip is the loss
+    scaler's business, not a divergence), a **loss spike** (robust z of
+    the new loss against the rolling window above ``z_gate``, upward
+    only), or a **grad-norm explosion** (same test on the grad-norm
+    series). Every verdict is logged as a ``numerics.divergence`` trace
+    instant, appended to ``runtime_stats["verdicts"]`` (the graftcheck
+    ``numerics-divergence`` rule's feed), optionally recorded as a
+    membership transition, and carries the configured action for
+    :meth:`apply_action` to execute.
+    """
+
+    def __init__(
+        self,
+        action: str = "halt",
+        *,
+        window: int = 64,
+        z_gate: float = 8.0,
+        min_history: int = 8,
+        nonfinite_patience: int = 2,
+        store=None,
+        clock=time.time,
+    ):
+        if action not in ACTIONS:
+            raise ValueError(
+                f"numerics action {action!r}: expected one of {ACTIONS}"
+            )
+        self.action = action
+        self.window = int(window)
+        self.z_gate = float(z_gate)
+        self.min_history = int(min_history)
+        self.nonfinite_patience = int(nonfinite_patience)
+        self.store = store  # membership store: verdicts -> transitions
+        self._clock = clock
+        self._loss: collections.deque = collections.deque(maxlen=window)
+        self._gnorm: collections.deque = collections.deque(maxlen=window)
+        self._streak = 0
+        self.tripped: dict | None = None
+        runtime_stats["action"] = action
+
+    def reset(self) -> None:
+        """Re-arm after a rollback: the rolled-back window's statistics
+        describe the divergent trajectory, not the resumed one."""
+        self._loss.clear()
+        self._gnorm.clear()
+        self._streak = 0
+        self.tripped = None
+
+    def observe(
+        self,
+        *,
+        step: int | None = None,
+        loss: float | None = None,
+        grad_norm: float | None = None,
+        nonfinite: bool = False,
+        blame: dict | None = None,
+    ) -> dict | None:
+        """One step's health facts in; a verdict dict out on a trip."""
+        if nonfinite:
+            self._streak += 1
+            if self._streak >= self.nonfinite_patience:
+                return self._trip(
+                    "nonfinite", step,
+                    detail=(
+                        f"{self._streak} consecutive non-finite steps"
+                        + (
+                            f", first offender {blame['leaf']!r}"
+                            f" (layer {blame['layer']})"
+                            if blame else ""
+                        )
+                    ),
+                    blame=blame,
+                )
+            return None
+        self._streak = 0
+
+        for name, series, value in (
+            ("loss-spike", self._loss, loss),
+            ("grad-explosion", self._gnorm, grad_norm),
+        ):
+            if value is None or not math.isfinite(value):
+                continue
+            if len(series) >= self.min_history:
+                z = _robust_z(value, series)
+                if z > self.z_gate:
+                    return self._trip(
+                        name, step,
+                        detail=(
+                            f"value {value:.6g} is z={z:.1f} above the "
+                            f"rolling window of {len(series)} "
+                            f"(median {sorted(series)[len(series)//2]:.6g},"
+                            f" gate {self.z_gate})"
+                        ),
+                        z=z, value=value,
+                    )
+            series.append(value)
+        return None
+
+    def _trip(self, kind, step, *, detail, blame=None, z=None, value=None):
+        verdict = {
+            "kind": kind,
+            "step": step,
+            "action": self.action,
+            "detail": detail,
+            "t": self._clock(),
+        }
+        if blame is not None:
+            verdict["blame"] = blame
+        if z is not None:
+            verdict["z"] = round(float(z), 3)
+            verdict["value"] = value
+        self.tripped = verdict
+        runtime_stats["verdicts"].append(verdict)
+        rolling_gauges["watchdog_trips_total"] = float(
+            len(runtime_stats["verdicts"])
+        )
+        _trace.instant(
+            "numerics.divergence", "fault",
+            kind=kind, step=step, action=self.action,
+        )
+        if self.store is not None:
+            try:
+                self.store.record_transition(
+                    "numerics_divergence",
+                    numerics_kind=kind, step=step, action=self.action,
+                )
+            except Exception:  # noqa: BLE001 — telemetry never kills a run
+                pass
+        return verdict
+
+    def apply_action(self, verdict: dict, *, manager=None, template=None):
+        """Execute the verdict's policy.
+
+        - ``halt``: raise :class:`NumericsDivergence`.
+        - ``rollback``: ``manager.restore_latest(template)`` — the last
+          COMMITTED portable checkpoint (torn dirs are skipped by the
+          manager) — re-arm the detector, and return ``(step, state)``
+          for the caller to resume from. No manager/committed step left
+          to roll back to degrades to ``halt``.
+        - ``degrade``: dial ``GRAFT_WIRE`` back to the f32 wire for the
+          restart (the quantized wire is the usual numerics suspect and
+          the one knob that changes numerics without changing the model)
+          and return None; the caller relaunches.
+        """
+        action = verdict.get("action", self.action)
+        if action == "rollback" and manager is not None:
+            restored = manager.restore_latest(template)
+            if restored is not None:
+                self.reset()
+                step, state = restored
+                _trace.instant(
+                    "numerics.rollback", "fault",
+                    restored_step=int(step),
+                    tripped_step=verdict.get("step"),
+                )
+                return int(step), state
+            verdict = dict(verdict, detail=(
+                verdict.get("detail", "")
+                + " [rollback found no committed checkpoint]"
+            ))
+            raise NumericsDivergence(verdict)
+        if action == "degrade":
+            os.environ["GRAFT_WIRE"] = "fp32"
+            runtime_stats["degraded_wire"] = True
+            _trace.instant(
+                "numerics.degrade", "fault",
+                tripped_step=verdict.get("step"), wire="fp32",
+            )
+            return None
+        raise NumericsDivergence(verdict)
+
+
+def probe_from_env(env=os.environ) -> NumericsProbe | None:
+    """``GRAFT_NUMERICS`` -> a probe, or None when the plane is off."""
+    raw = env.get("GRAFT_NUMERICS")
+    if raw is None or raw.strip().lower() in ("", "0", "false", "off", "no"):
+        return None
+    return NumericsProbe()
+
+
+def watchdog_from_env(env=os.environ) -> NumericsWatchdog:
+    """Watchdog with ``GRAFT_NUMERICS_ACTION`` policy (default halt)."""
+    return NumericsWatchdog(
+        action=env.get("GRAFT_NUMERICS_ACTION", "halt").strip().lower()
+        or "halt"
+    )

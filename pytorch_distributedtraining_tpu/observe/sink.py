@@ -45,6 +45,9 @@ def _is_rank0() -> bool:
         return True
 
 
+INF = float("inf")
+
+
 def _default_path() -> str:
     """Default JSONL location: under the run dir, never the cwd (a
     committed ``metrics.jsonl`` in the repo root was this default's
@@ -53,7 +56,31 @@ def _default_path() -> str:
 
 
 class MetricsSink:
-    """Interface: ``log(metrics, step=None)`` + ``finish()``."""
+    """Interface: ``log(metrics, step=None)`` + ``finish()``.
+
+    Every sink drops non-finite scalar values at this boundary: a NaN
+    written into JSONL breaks every ``json.loads`` consumer downstream
+    (Python emits bare ``NaN``/``Infinity``, which is not JSON), and
+    wandb charts silently swallow them. Dropped values are counted per
+    key in ``nonfinite_dropped`` — a health stat, never an exception.
+    """
+
+    def __init__(self):
+        self.nonfinite_dropped: dict[str, int] = {}
+
+    def _finite(self, metrics: dict[str, Any]) -> dict[str, Any]:
+        """Scalar-convert and filter: non-finite floats are dropped and
+        counted; everything else passes through ``_scalar``."""
+        out = {}
+        for k, v in metrics.items():
+            s = _scalar(v)
+            if isinstance(s, float) and (s != s or s in (INF, -INF)):
+                self.nonfinite_dropped[k] = (
+                    self.nonfinite_dropped.get(k, 0) + 1
+                )
+                continue
+            out[k] = s
+        return out
 
     def log(self, metrics: dict[str, Any], step: int | None = None) -> None:
         raise NotImplementedError
@@ -71,6 +98,7 @@ class JSONLSink(MetricsSink):
     """Offline fallback: one JSON object per log call."""
 
     def __init__(self, path: str | None = None):
+        super().__init__()
         self.path = path or _default_path()
         self._f = None
 
@@ -83,7 +111,7 @@ class JSONLSink(MetricsSink):
         rec = {"_time": time.time()}
         if step is not None:
             rec["_step"] = int(step)
-        rec.update({k: _scalar(v) for k, v in metrics.items()})
+        rec.update(self._finite(metrics))
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
 
@@ -106,6 +134,7 @@ class WandbSink(MetricsSink):
         retry_policy: "RetryPolicy | None" = None,
         **init_kwargs,
     ):
+        super().__init__()
         self._run = None
         if not _is_rank0():
             return
@@ -136,7 +165,7 @@ class WandbSink(MetricsSink):
     def log(self, metrics, step=None):
         if self._run is None:
             return
-        self._wandb.log({k: _scalar(v) for k, v in metrics.items()}, step=step)
+        self._wandb.log(self._finite(metrics), step=step)
 
     def finish(self):
         if self._run is not None:

@@ -566,6 +566,16 @@ def flush_flight_record(
             serve_inflight = slo_mod.inflight_requests()
             if serve_inflight:
                 doc["serve_in_flight"] = serve_inflight
+        # the numerics half: grad-norm / non-finite blame / watchdog
+        # verdicts at the moment of death — a crash mid-divergence keeps
+        # its numerics story. Same sys.modules contract as above.
+        num_mod = sys.modules.get(
+            "pytorch_distributedtraining_tpu.observe.numerics"
+        )
+        if num_mod is not None:
+            num_snap = num_mod.snapshot()
+            if num_snap.get("steps_observed"):
+                doc["numerics"] = num_snap
         if exc is not None:
             doc["exception"] = {
                 "type": type(exc).__name__,
@@ -640,6 +650,13 @@ def describe_flight_record(doc: dict) -> str:
         doing += (
             f" with {len(serve)} serve request(s) in flight "
             f"({phases}{more})"
+        )
+    num = doc.get("numerics") or {}
+    if num.get("nonfinite_steps_total"):
+        blame = num.get("last_nonfinite") or {}
+        doing += (
+            f"; numerics: {num['nonfinite_steps_total']} non-finite "
+            f"step(s), last blame {blame.get('leaf', '?')}"
         )
     cause = f" [{exc['type']}: {exc['message']}]" if exc else ""
     return (

@@ -49,6 +49,16 @@ def finish() -> None:
         _sink = None
 
 
+def nonfinite_dropped() -> dict:
+    """Per-key counts of non-finite scalars the sink boundary dropped
+    (see ``MetricsSink._finite``) — a post-run health check: any entry
+    here means something upstream (eval metric, loss, probe) produced a
+    NaN/Inf that would have corrupted the JSONL/wandb stream."""
+    if _sink is None:
+        return {}
+    return dict(getattr(_sink, "nonfinite_dropped", {}) or {})
+
+
 class _Config(dict):
     def __getattr__(self, k):
         try:

@@ -162,6 +162,65 @@ def ema_params(opt_state, params=None):
     return None
 
 
+class RecordedClipState(NamedTuple):
+    """Pre-clip global norm + whether this step actually clipped.
+
+    ``optax.clip_by_global_norm`` computes the global norm and throws it
+    away (EmptyState); recording it here means the numerics probe and
+    the step's ``grad_norm`` metric read it from the optimizer state
+    instead of computing the norm a second time, and bench can report
+    ``clip_fraction`` (the share of steps the clip actually fired)."""
+
+    gnorm: jnp.ndarray  # f32 scalar, PRE-clip global norm
+    clipped: jnp.ndarray  # bool scalar: the scale was < 1 this step
+
+
+def clip_by_global_norm_recorded(
+    max_norm: float,
+) -> optax.GradientTransformation:
+    """``optax.clip_by_global_norm`` twin whose state records the
+    pre-clip norm and a clipped flag (see :class:`RecordedClipState`).
+    Numerically identical to optax's: scale = min(1, max_norm/gnorm)."""
+    max_norm = float(max_norm)
+
+    def init(params):
+        del params
+        return RecordedClipState(
+            gnorm=jnp.zeros((), jnp.float32),
+            clipped=jnp.zeros((), jnp.bool_),
+        )
+
+    def update(updates, state, params=None):
+        del params, state
+        gnorm = optax.global_norm(updates)
+        trigger = gnorm > max_norm
+        scale = jnp.where(
+            trigger, max_norm / jnp.maximum(gnorm, 1e-38), 1.0
+        ).astype(jnp.float32)
+        updates = jax.tree.map(
+            lambda u: (u * scale).astype(u.dtype), updates
+        )
+        return updates, RecordedClipState(
+            gnorm=gnorm.astype(jnp.float32), clipped=trigger
+        )
+
+    return optax.GradientTransformation(init, update)
+
+
+def clip_stats(opt_state) -> RecordedClipState | None:
+    """Find the :class:`RecordedClipState` inside a chain's state tuple
+    (None when the chain has no recorded clip). Walks plain tuples only —
+    optax chain states are (nested) tuples of NamedTuples."""
+    if isinstance(opt_state, RecordedClipState):
+        return opt_state
+    if isinstance(opt_state, tuple):
+        for child in opt_state:
+            found = clip_stats(child)
+            if found is not None:
+                return found
+    return None
+
+
 def adamw(
     lr: float | optax.Schedule = 1e-3,
     betas: tuple = (0.9, 0.999),
@@ -181,7 +240,10 @@ def adamw(
     """
     chain = []
     if clip_grad_norm is not None:
-        chain.append(optax.clip_by_global_norm(clip_grad_norm))
+        # recorded variant: the pre-clip global norm lands in the opt
+        # state so TrainStep's grad_norm metric / the numerics probe
+        # never compute it twice (see clip_by_global_norm_recorded)
+        chain.append(clip_by_global_norm_recorded(clip_grad_norm))
     if clip_grad_value is not None:
         chain.append(optax.clip(clip_grad_value))
     chain.append(
@@ -205,7 +267,7 @@ def sgd(
 ) -> optax.GradientTransformation:
     chain = []
     if clip_grad_norm is not None:
-        chain.append(optax.clip_by_global_norm(clip_grad_norm))
+        chain.append(clip_by_global_norm_recorded(clip_grad_norm))
     if clip_grad_value is not None:
         chain.append(optax.clip(clip_grad_value))
     if weight_decay:

@@ -251,6 +251,7 @@ class CompressedGradStep:
         axis_name: str = "dp",
         donate: bool = False,
         wire: "str | WireFormat | None" = "int8",
+        numerics=None,
     ):
         policy = policy or DDP()
         if policy.shard_params:
@@ -300,6 +301,15 @@ class CompressedGradStep:
         self.n_data_shards = 1
         for a in axes:
             self.n_data_shards *= mesh.shape[a]
+        # numerics observability (observe/numerics.py): same contract as
+        # TrainStep's probe, plus the error-feedback residual health only
+        # this step can report (a growing residual norm means the
+        # quantizer is diverging, not converging)
+        from ..observe.numerics import NumericsProbe
+
+        self.numerics = (
+            NumericsProbe() if numerics is True else (numerics or None)
+        )
         self._jitted = jax.jit(
             self._step, donate_argnums=(0,) if donate else ()
         )
@@ -534,6 +544,8 @@ class CompressedGradStep:
             check_vma=False,  # reductions are replicated/owned by construction
         )(state.params, residuals, batch)
 
+        if self.numerics is not None:
+            grads = self.numerics.inject(grads, state.step)
         updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
         updates = jax.tree.map(lambda u: u * lr_factor, updates)
         new_params = optax.apply_updates(state.params, updates)
@@ -543,7 +555,20 @@ class CompressedGradStep:
             opt_state=new_opt,
             model_state={**extra_state, "grad_residual": new_res},
         )
-        return new_state, {"loss": loss.astype(jnp.float32)}
+        metrics = {"loss": loss.astype(jnp.float32)}
+        if self.numerics is not None:
+            from ..optim import clip_stats
+
+            rc = clip_stats(new_opt)
+            metrics["numerics"] = self.numerics.aux(
+                grads,
+                params=state.params,
+                updates=updates,
+                model_state=extra_state,
+                residuals=new_res,
+                grad_norm=rc.gnorm if rc is not None else None,
+            )
+        return new_state, metrics
 
     def _with_residuals(self, state: TrainState) -> TrainState:
         if "grad_residual" in state.model_state:

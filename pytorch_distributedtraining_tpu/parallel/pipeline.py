@@ -902,7 +902,9 @@ class PipelineStep:
         state_shardings=None,
         extra_metrics: bool = True,
         donate: bool = True,
+        numerics=None,
     ):
+        from ..observe.numerics import NumericsProbe
         from ..runtime.mesh import batch_spec
         from .policy import Policy
 
@@ -928,6 +930,12 @@ class PipelineStep:
         self.head_fn = head_fn
         self.extra_metrics = extra_metrics
         self.donate = donate
+        # numerics observability: TrainStep's fused-aux contract; the
+        # scan-stacked stage axis is exactly the layer axis the probe's
+        # blame vector resolves, so a NaN names its pipeline stage
+        self.numerics = (
+            NumericsProbe() if numerics is True else (numerics or None)
+        )
         self._state_shardings = state_shardings
         data_sharding = NamedSharding(mesh, batch_spec(mesh))
         self._jitted = jax.jit(
@@ -971,16 +979,35 @@ class PipelineStep:
         )
         grads = constrain(grads, gspecs, self.mesh)
 
+        if self.numerics is not None:
+            grads = self.numerics.inject(grads, state.step)
         updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
         updates = jax.tree.map(lambda u: u * lr_factor, updates)
         new_params = optax.apply_updates(state.params, updates)
         new_opt = refresh_params_ema(state.opt_state, new_opt, new_params)
 
+        from ..optim import clip_stats
+
+        recorded_clip = clip_stats(new_opt)
         metrics = {"loss": loss.astype(jnp.float32)}
         if self.extra_metrics:
-            metrics["grad_norm"] = optax.global_norm(grads)
+            metrics["grad_norm"] = (
+                recorded_clip.gnorm
+                if recorded_clip is not None
+                else optax.global_norm(grads)
+            )
             metrics["bubble_fraction"] = jnp.float32(
                 self.schedule.bubble_fraction
+            )
+        if self.numerics is not None:
+            metrics["numerics"] = self.numerics.aux(
+                grads,
+                params=state.params,
+                updates=updates,
+                grad_norm=(
+                    recorded_clip.gnorm
+                    if recorded_clip is not None else None
+                ),
             )
         new_state = state.replace(
             step=state.step + 1,

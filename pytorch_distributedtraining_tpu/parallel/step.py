@@ -34,7 +34,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..observe import trace as telemetry
-from ..optim import FusedAdamW, refresh_params_ema
+from ..observe.numerics import NumericsProbe
+from ..optim import FusedAdamW, clip_stats, refresh_params_ema
 from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
 from ..runtime.mesh import batch_spec, stacked_batch_spec
 from .policy import Policy
@@ -77,6 +78,7 @@ class TrainStep:
         donate: bool = True,
         detect_anomaly: bool = False,
         update_wire_dtype=None,
+        numerics: NumericsProbe | bool | None = None,
     ):
         self.loss_fn = loss_fn
         self.tx = tx
@@ -101,6 +103,16 @@ class TrainStep:
         # donate=False so the pre-step state survives for inspection when
         # the (possibly async) callback error surfaces.
         self.detect_anomaly = detect_anomaly
+        # Numerics observability plane (observe/numerics.py): one fused
+        # aux computation appended to the step — first-offender blame,
+        # grad/param norms, update ratios, fp8/wire health — landing
+        # under metrics["numerics"] for the host probe/watchdog. Unlike
+        # detect_anomaly this costs NO device sync; the host decodes at
+        # its own cadence.
+        self.numerics = (
+            NumericsProbe() if numerics is True
+            else (numerics or None)
+        )
         # Fairscale OSS broadcast_fp16 twin (`Stoke-DDP.py:197-199`): under
         # ZeRO the optimizer update is computed on sharded state and fans
         # out through an implicit all-gather; casting the update to a
@@ -250,9 +262,15 @@ class TrainStep:
                 state.params, state.model_state, batch, rng, state.scaler
             )
 
+        if self.numerics is not None:
+            # deterministic NaN drill (GRAFT_NUMERICS_INJECT): branchless
+            # on the traced step counter, a no-op without a spec
+            grads = self.numerics.inject(grads, state.step)
+
         new_scaler = None
         finite = jnp.bool_(True)
         gnorm_fused = None
+        updates = None  # tree path sets it; the probe's update-ratio feed
         if self.fused is not None:
             # flat path: ravel once, scaler/clip/Adam as full-width vector
             # ops, unravel once (see optim.FusedAdamW.apply_tree)
@@ -330,14 +348,33 @@ class TrainStep:
 
         new_model_state = aux.get("model_state", state.model_state)
         metrics = {"loss": loss.astype(jnp.float32)}
+        # the recorded-clip chain element (optim.clip_by_global_norm_
+        # recorded) already computed the pre-clip global norm; read it
+        # from the fresh opt state instead of computing the norm twice
+        recorded_clip = clip_stats(new_opt)
+        gnorm_known = (
+            gnorm_fused
+            if gnorm_fused is not None
+            else (recorded_clip.gnorm if recorded_clip is not None else None)
+        )
         if self.extra_metrics:
             metrics["grad_norm"] = (
-                gnorm_fused
-                if gnorm_fused is not None
+                gnorm_known
+                if gnorm_known is not None
                 else optax.global_norm(grads)
             )
+            if recorded_clip is not None:
+                metrics["grad_clipped"] = recorded_clip.clipped
             if new_scaler is not None:
                 metrics["loss_scale"] = new_scaler.scale
+        if self.numerics is not None:
+            metrics["numerics"] = self.numerics.aux(
+                grads,
+                params=state.params,
+                updates=updates,
+                model_state=new_model_state,
+                grad_norm=gnorm_known,
+            )
         for k, v in aux.items():
             if k != "model_state":
                 metrics[k] = v

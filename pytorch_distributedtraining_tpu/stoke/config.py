@@ -110,6 +110,13 @@ class TPUConfig:
     # $GRAFT_TRACE also enables and names the Chrome-trace export path.
     telemetry: bool = False
     trace_dir: str | None = None
+    # Numerics observability plane (observe/numerics.py): fused on-device
+    # probes (non-finite blame, grad/param norms, update ratios, fp8/wire
+    # health) + the host-side divergence watchdog. ``numerics_action`` is
+    # the watchdog policy: "halt" | "rollback" | "degrade". Env twins:
+    # $GRAFT_NUMERICS, $GRAFT_NUMERICS_ACTION.
+    numerics: bool = False
+    numerics_action: str = "halt"
 
 
 @dataclass

@@ -160,6 +160,32 @@ def _apply_fp8_env(model, cfg):
     return model, None
 
 
+def _numerics_from_env(cfg):
+    """Resolve the numerics plane: ``$GRAFT_NUMERICS`` overrides
+    ``TPUConfig.numerics`` (same env-twin pattern as GRAFT_WIRE), and
+    ``$GRAFT_NUMERICS_ACTION`` overrides ``TPUConfig.numerics_action``.
+    Returns ``(enabled, action)``; a bad action spelling fails here, at
+    construction, not at the first watchdog trip."""
+    env = os.environ.get("GRAFT_NUMERICS")
+    if env is not None:
+        on = env.strip().lower() not in ("", "0", "false", "off", "no")
+    else:
+        on = bool(cfg.numerics)
+    action = (
+        os.environ.get("GRAFT_NUMERICS_ACTION", cfg.numerics_action)
+        .strip().lower()
+        or "halt"
+    )
+    from ..observe.numerics import ACTIONS
+
+    if action not in ACTIONS:
+        raise ValueError(
+            f"numerics action {action!r}: expected one of {ACTIONS} "
+            "(GRAFT_NUMERICS_ACTION / TPUConfig.numerics_action)"
+        )
+    return on, action
+
+
 def _telemetry_from_env(cfg):
     """Resolve the telemetry switch: ``$GRAFT_TELEMETRY`` overrides
     ``TPUConfig.telemetry`` (deploy-time twin, same pattern as GRAFT_WIRE);
@@ -485,6 +511,32 @@ class Stoke:
             from ..observe import trace as _telemetry
 
             _telemetry.enable()
+        # numerics observability plane (env > TPUConfig): fused on-device
+        # probes on the step + the host-side divergence watchdog; the
+        # probe aux rides metrics["numerics"] out of fused_step, decoded
+        # at the GRAFT_NUMERICS_EVERY cadence (a decode costs one
+        # device→host fetch — default every step; raise it on a tunnel)
+        numerics_on, numerics_action = _numerics_from_env(self.tpu_config)
+        self.numerics_probe = None
+        self.numerics_watchdog = None
+        if numerics_on:
+            from ..observe import numerics as _numerics
+
+            fp8_max = None
+            if self.fp8 is not None:
+                from ..precision import FP8_DTYPES, _fp8_max
+
+                fp8_max = _fp8_max(FP8_DTYPES[self.fp8])
+            self.numerics_probe = _numerics.NumericsProbe(
+                **({"fp8_max": fp8_max} if fp8_max else {})
+            )
+            self.numerics_watchdog = _numerics.NumericsWatchdog(
+                action=numerics_action
+            )
+        self._numerics_every = max(
+            1, int(os.environ.get("GRAFT_NUMERICS_EVERY", "1") or 1)
+        )
+        self._numerics_count = 0
 
         # -- distribution policy ------------------------------------------
         distributed = (
@@ -1320,6 +1372,7 @@ class Stoke:
                         self.policy,
                         donate=self.tpu_config.donate_state,
                         wire=self.wire,
+                        numerics=self.numerics_probe,
                     )
                     return self._fused
                 except ValueError as e:  # ZeRO-3 / non-data mesh axes
@@ -1350,6 +1403,7 @@ class Stoke:
                 if isinstance(self._tx, optim_mod.FusedAdamW)
                 else self._update_wire_dtype()
             ),
+            numerics=self.numerics_probe,
         )
         return self._fused
 
@@ -1390,7 +1444,30 @@ class Stoke:
             lr_factor=self._opt_handle.lr,
         )
         self._note_loss(metrics["loss"])
+        self._observe_numerics(metrics)
         return metrics
+
+    def _observe_numerics(self, metrics) -> None:
+        """Decode the step's numerics aux at the configured cadence and
+        feed the watchdog. A ``halt`` trip raises NumericsDivergence out
+        of the step; ``rollback``/``degrade`` trips record the verdict
+        (``Stoke.numerics_watchdog.tripped``) for the training loop /
+        launcher to act on — the facade has no checkpoint manager of its
+        own to roll back through."""
+        if self.numerics_probe is None or "numerics" not in metrics:
+            return
+        self._numerics_count += 1
+        if self._numerics_count % self._numerics_every:
+            return
+        summary = self.numerics_probe.observe(
+            metrics["numerics"],
+            step=self._numerics_count,
+            loss=metrics.get("loss"),
+            watchdog=self.numerics_watchdog,
+        )
+        verdict = summary.get("verdict")
+        if verdict is not None and verdict.get("action") == "halt":
+            self.numerics_watchdog.apply_action(verdict)
 
     def pipeline_step(
         self,

@@ -62,7 +62,9 @@ def test_metrics_mae_psnr():
     np.testing.assert_allclose(
         float(metrics.psnr(a, b)), 10 * np.log10(1 / 0.0625), rtol=1e-5
     )
-    assert float(metrics.psnr(a, a)) > 300  # identical images: huge but finite
+    # identical images: the pinned mse epsilon caps PSNR at a stable
+    # 100 dB instead of a float-noise-dependent huge value
+    np.testing.assert_allclose(float(metrics.psnr(a, a)), 100.0, atol=0.01)
 
 
 def test_psnr_data_range():
