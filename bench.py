@@ -391,11 +391,47 @@ def _emit_result(line: str) -> None:
         rec = json.loads(line)
         verdict = _regression_sentry(rec)
         if verdict is not None:
+            if verdict["status"] in ("drift", "regression"):
+                # op-level attribution (benchmarks/trace_diff.py): name
+                # WHERE the time went — which op class / collectives grew
+                # vs the last-good record's opcost table. A regression
+                # that blocks the last-good refresh below must carry this
+                # block (or an explicit reason it couldn't be built).
+                try:
+                    sys.path.insert(
+                        0,
+                        os.path.join(
+                            os.path.dirname(os.path.abspath(__file__)),
+                            "benchmarks",
+                        ),
+                    )
+                    from trace_diff import attribute_records
+
+                    last_good = _read_last_good()
+                    verdict["attribution"] = (
+                        attribute_records(last_good, rec)
+                        if last_good
+                        else {
+                            "available": False,
+                            "reason": "no last-good record to diff against",
+                        }
+                    )
+                except Exception as e:  # noqa: BLE001 — never block publish
+                    verdict["attribution"] = {
+                        "available": False,
+                        "reason": f"attribution failed: {e}",
+                    }
             rec["regression"] = verdict
             line = json.dumps(rec)
             if verdict["status"] in ("drift", "regression"):
+                attr = verdict.get("attribution") or {}
                 _status(
                     f"regression sentry: {verdict.get('detail', verdict['status'])}"
+                    + (
+                        f" — {attr['detail']}"
+                        if attr.get("available") and attr.get("detail")
+                        else ""
+                    )
                 )
     except Exception:
         pass
@@ -1453,6 +1489,28 @@ def _bench() -> None:
     ):
         telemetry.enable()
 
+    # anomaly-triggered capture (observe/capture.py): armed by default so
+    # the bench prices the armed-but-idle poll cost inside the same 1%
+    # overhead gate as the spans (an instrument a training loop can't
+    # afford to keep armed must not claim it's free here). Fires a
+    # bounded jax.profiler capture on straggler / SLO-burn / numerics /
+    # regression signals. GRAFT_CAPTURE=0 opts out; any other non-flag
+    # value names the capture dir (default: under the run dir).
+    capture_prof = None
+    _cap_env = os.environ.get("GRAFT_CAPTURE")
+    if (_cap_env if _cap_env is not None else "1").strip().lower() not in (
+        "", "0", "false", "off", "no"
+    ):
+        from pytorch_distributedtraining_tpu.observe.capture import (
+            OnDemandProfiler,
+        )
+
+        _cap_dir = None
+        if _cap_env and _cap_env.strip().lower() not in ("1", "true", "on",
+                                                         "yes"):
+            _cap_dir = _cap_env.strip()
+        capture_prof = OnDemandProfiler(trace_dir=_cap_dir).arm()
+
     def _sync(x):
         # the post-dispatch wait IS the device compute tail of a timed
         # window — billed productive (cat "step") alongside the dispatch
@@ -1589,6 +1647,8 @@ def _bench() -> None:
                 for _ in range(n_calls):
                     with telemetry.span("step.dispatch", "step", k=k):
                         state, losses = multi_step(state)
+                    if capture_prof is not None:
+                        capture_prof.note_step()
                 _sync(losses)
                 dt = time.perf_counter() - t0
                 rates.append(BATCH * k * n_calls / dt)
@@ -1615,6 +1675,8 @@ def _bench() -> None:
                         state, metrics = step(state, b)
                     if num_probe is not None and "numerics" in metrics:
                         num_aux.append(metrics["numerics"])
+                    if capture_prof is not None:
+                        capture_prof.note_step()
                     n_steps += 1
                 _sync(metrics["loss"])
                 dt = time.perf_counter() - t0
@@ -1637,6 +1699,8 @@ def _bench() -> None:
                     state, metrics = step(state, batch)
                     if num_probe is not None and "numerics" in metrics:
                         num_aux.append(metrics["numerics"])
+                    if capture_prof is not None:
+                        capture_prof.note_step()
                 _sync(metrics["loss"])
                 dt = time.perf_counter() - t0
                 rates.append(BATCH * STEPS / dt)
@@ -1776,6 +1840,7 @@ def _bench() -> None:
     time_breakdown = None
     telemetry_overhead_fraction = None
     fleet_summary = None
+    flops_per_step = None  # also feeds the mfu_flops calibration below
     if telemetry.enabled():
         from pytorch_distributedtraining_tpu.observe.goodput import (
             GoodputLedger,
@@ -1816,10 +1881,21 @@ def _bench() -> None:
                 pass
         per_span_s = (time.perf_counter() - t_p) / probe_n
         spans_per_step = n_window_spans / max(1, len(rates) * actual_steps)
+        # armed-but-idle capture cost: note_step() per step is one poll
+        # over the anomaly sources' module dicts — measure it raw and
+        # charge it to the same budget (an armed profiler that can't
+        # stay under 1% has no business being armed in training loops)
+        per_poll_s = 0.0
+        if capture_prof is not None:
+            t_cp = time.perf_counter()
+            for _ in range(probe_n):
+                capture_prof.poll()
+            per_poll_s = (time.perf_counter() - t_cp) / probe_n
         # the numerics decode is instrumentation a training loop pays per
         # step too — it shares the 1% budget with the spans
         telemetry_overhead_fraction = round(
-            per_span_s * spans_per_step / max(step_time_best, 1e-9)
+            (per_span_s * spans_per_step + per_poll_s)
+            / max(step_time_best, 1e-9)
             + (numerics_overhead_fraction or 0.0),
             6,
         )
@@ -1831,6 +1907,7 @@ def _bench() -> None:
                 "time_breakdown": time_breakdown,
                 "overhead_fraction": telemetry_overhead_fraction,
                 "spans_per_step": round(spans_per_step, 3),
+                "capture_poll_us": round(per_poll_s * 1e6, 2),
             }),
             flush=True,
         )
@@ -2021,6 +2098,17 @@ def _bench() -> None:
     peak_hbm_bytes = None
     try:
         mem = step.memory_analysis(state, batch)
+        # live HBM high-water/in-use into observe.memory's module stats
+        # (the crash flight record picks them up via sys.modules)
+        from pytorch_distributedtraining_tpu.observe.memory import (
+            record_hbm_stats,
+        )
+
+        record_hbm_stats(
+            projected_peak_bytes=(
+                mem.peak_bytes if mem is not None else None
+            )
+        )
         if mem is not None:
             peak_hbm_bytes = mem.peak_bytes
             print(
@@ -2064,6 +2152,152 @@ def _bench() -> None:
                 )
         except Exception as e:  # noqa: BLE001 — provenance, not the metric
             print(f"# child: pipeline probe unavailable: {e}", flush=True)
+    # Op-cost attribution + cost-model calibration (untimed, after every
+    # gate that polices the timed windows): parse a short steady-state
+    # profiler trace into per-class cost tables and per-axis collective
+    # bandwidth, then score the analytic models (MFU FLOPs, the
+    # hops-model wire bytes, the pipeline bubble) against what was
+    # measured (observe/opcost.py). The per-class table is what
+    # benchmarks/trace_diff.py diffs when the regression sentry fires.
+    # GRAFT_OPCOST=0 opts out.
+    opcost_block = None
+    calibration_block = None
+    _opc_env = os.environ.get("GRAFT_OPCOST")
+    if (_opc_env if _opc_env is not None else "1").strip().lower() not in (
+        "", "0", "false", "off", "no"
+    ):
+        try:
+            from pytorch_distributedtraining_tpu.observe import (
+                opcost as opcost_mod,
+                profiling as _prof,
+            )
+
+            opcost_trace_dir = trace_dir
+            opcost_steps = 3  # the GRAFT_BENCH_TRACE pre-window trace
+            if not opcost_trace_dir:
+                # no pre-window trace: capture 2 steps now into the run
+                # dir (the guarded trace no-ops if an anomaly capture is
+                # still in flight; ingest then finds nothing and skips)
+                opcost_trace_dir = os.path.join(
+                    telemetry.run_dir(), "opcost_trace"
+                )
+                opcost_steps = 2
+                with mesh, _prof.trace(opcost_trace_dir):
+                    for _ in range(opcost_steps):
+                        state, _opc_metrics = step(state, batch)
+                    jax.block_until_ready(_opc_metrics["loss"])
+            hlo_text = None
+            try:
+                hlo_text = step.compiled_text(state, batch)
+            except Exception as e:  # noqa: BLE001 — join is optional
+                print(f"# child: opcost hlo unavailable: {e}", flush=True)
+            ingest = opcost_mod.ingest_trace(
+                opcost_trace_dir,
+                hlo_text=hlo_text,
+                mesh_axes=dict(mesh.shape),
+                steps=opcost_steps,
+            )
+            if ingest is None:
+                print("# child: opcost trace empty", flush=True)
+            else:
+                tbl = ingest["table"]
+                nsteps = max(1, opcost_steps)
+                per_class_s = {
+                    cls: round(row["seconds"] / nsteps, 9)
+                    for cls, row in tbl["classes"].items()
+                }
+                bw = ingest["bandwidth"] or {}
+                opcost_block = {
+                    "trace_steps": nsteps,
+                    "total_s": round(tbl["total_s"] / nsteps, 9),
+                    "per_class_s": per_class_s,
+                    "collectives": {
+                        r["op"]: round(r["s"] / nsteps, 9)
+                        for r in tbl["collectives"]
+                    },
+                    "axis_bytes_per_s": {
+                        ax: (
+                            round(row["bytes_per_s"], 1)
+                            if row.get("bytes_per_s")
+                            else None
+                        )
+                        for ax, row in bw.items()
+                    } or None,
+                }
+                print(
+                    "# child: opcost " + json.dumps(opcost_block),
+                    flush=True,
+                )
+                models = {}
+                if flops_per_step:
+                    from pytorch_distributedtraining_tpu.observe.goodput \
+                        import peak_flops
+                    dev0 = jax.devices()[0]
+                    pf = peak_flops(
+                        dev0.platform, getattr(dev0, "device_kind", "")
+                    )
+                    if pf and per_class_s.get("compute"):
+                        models["mfu_flops"] = {
+                            "analytic": flops_per_step / pf,
+                            "measured": per_class_s["compute"],
+                            "unit": "s",
+                        }
+                # wire model: hops-convention analytic bytes (wire_cost /
+                # comm_cost walk the params) vs what XLA actually emitted
+                # (the HLO wire-inventory join behind the bandwidth rows)
+                measured_wire_bytes = (
+                    sum(row.get("bytes", 0) for row in bw.values()) / nsteps
+                )
+                analytic_wire = None
+                if wire_info is not None:
+                    analytic_wire = wire_info.get("wire_bytes")
+                else:
+                    try:
+                        analytic_wire = step.comm_cost(
+                            state.params
+                        )["fp32_bytes"]
+                    except Exception:  # noqa: BLE001 — optional model
+                        analytic_wire = None
+                if analytic_wire and measured_wire_bytes:
+                    models["wire"] = {
+                        "analytic": float(analytic_wire),
+                        "measured": float(measured_wire_bytes),
+                        "unit": "bytes",
+                    }
+                if bubble_fraction and opcost_block["total_s"]:
+                    # measured bubble: the device-idle share of the best
+                    # window's step — 1 - busy/wall (an approximation:
+                    # the trace's op seconds are the busy side)
+                    busy = min(opcost_block["total_s"], step_time_best)
+                    models["bubble"] = {
+                        "analytic": float(bubble_fraction),
+                        "measured": max(
+                            0.0, 1.0 - busy / max(step_time_best, 1e-9)
+                        ),
+                        "unit": "fraction",
+                    }
+                prev_cal = (_read_last_good() or {}).get("calibration")
+                calibration_block = (
+                    opcost_mod.calibrate(models, previous=prev_cal) or None
+                )
+                if calibration_block:
+                    cal_path = opcost_mod.write_calibration(
+                        os.path.join(
+                            telemetry.run_dir(), "calibration.json"
+                        ),
+                        calibration_block,
+                        meta={
+                            "metric": METRIC,
+                            "value": round(img_per_sec, 2),
+                        },
+                    )
+                    print(
+                        f"# child: calibration -> {cal_path} "
+                        + json.dumps(calibration_block),
+                        flush=True,
+                    )
+        except Exception as e:  # noqa: BLE001 — accounting, not the metric
+            print(f"# child: opcost unavailable: {e}", flush=True)
     cache_entries_now = cache_entry_count(cache_path)
     compile_cache = {
         "enabled": cache_path is not None,
@@ -2101,6 +2335,13 @@ def _bench() -> None:
                 "telemetry_overhead_fraction": telemetry_overhead_fraction,
                 "numerics": numerics_block,
                 "fleet": fleet_summary,
+                "opcost": opcost_block,
+                "calibration": calibration_block,
+                "capture": (
+                    capture_prof.summary()
+                    if capture_prof is not None
+                    else None
+                ),
                 "compile_cache": compile_cache,
                 "static_findings": static_findings,
                 "peak_hbm_bytes": peak_hbm_bytes,

@@ -89,6 +89,11 @@ STAGES = [
                  "clip_fraction, non-finite blame + watchdog verdict from "
                  "the bench record's numerics block (bench.py fused probe; "
                  "trace_summary.py rolls up the numerics.* instants)"),
+    ("opcost", "op-cost attribution plane: per-class cost table, per-axis "
+               "collective bandwidth + cost-model calibration from the "
+               "bench record's opcost/calibration blocks (bench.py; "
+               "trace_summary.py prints the opcost_classes_ms rollup, "
+               "trace_diff.py attributes regressions)"),
     ("ladder", "five-config ladder (ladder.py --all)"),
 ]
 
@@ -119,6 +124,8 @@ ARM_KNOBS = {
     "serve": "GRAFT_BENCH_SERVE=1",
     # numerics plane arm (health record, never a throughput winner)
     "numerics": "GRAFT_NUMERICS=1 GRAFT_NUMERICS_ACTION=halt",
+    # op-cost attribution arm (attribution record, never a winner)
+    "opcost": "GRAFT_OPCOST=1 GRAFT_CAPTURE=1",
 }
 
 

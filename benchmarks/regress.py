@@ -76,6 +76,25 @@ def main(argv=None) -> int:
     verdict = fleet.regression_verdict(
         fresh, history, warn_frac=opt.warn_frac, err_frac=opt.err_frac,
     )
+    if verdict.get("status") in ("drift", "regression"):
+        # op-level attribution: diff the fresh record's opcost table
+        # against the newest historical record that carries one, so the
+        # verdict names WHERE the time went, not just that it did
+        from trace_diff import attribute_records
+
+        baseline = next(
+            (r for r in reversed(history) if isinstance(r, dict)
+             and isinstance(r.get("opcost"), dict)),
+            None,
+        )
+        verdict["attribution"] = (
+            attribute_records(baseline, fresh)
+            if baseline is not None
+            else {
+                "available": False,
+                "reason": "no historical record carries an opcost block",
+            }
+        )
     print(json.dumps(verdict))
     return _EXIT.get(verdict["status"], 0)
 

@@ -33,12 +33,11 @@ from __future__ import annotations
 
 import argparse
 import collections
-import glob
-import gzip
 import json
-import os
 
 import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+from pytorch_distributedtraining_tpu.observe import opcost as _opcost
 
 _SCAFFOLD = (
     "block_until_ready", "try_to_block", "ThunkExecutor", "trace",
@@ -48,30 +47,16 @@ _SCAFFOLD = (
 
 def load_events(trace_dir: str):
     """All events from every trace file (multi-host dirs have one per
-    host); a bare .json whose .gz sibling exists is skipped, not doubled."""
-    pats = [
-        os.path.join(trace_dir, "**", "*.trace.json.gz"),
-        os.path.join(trace_dir, "**", "*.trace.json"),
-    ]
-    files = sorted(
-        f for pat in pats for f in glob.glob(pat, recursive=True)
-    )
-    files = [f for f in files if not (
-        f.endswith(".json") and f + ".gz" in files
-    )]
-    if not files:
-        raise SystemExit(f"no *.trace.json(.gz) under {trace_dir}")
-    # one profiling RUN = one timestamped parent dir; merge only the
-    # newest run's files (multi-host: one file per host) — summing
-    # several runs would silently multiply every op time
-    newest_run = max(os.path.dirname(f) for f in files)
-    files = [f for f in files if os.path.dirname(f) == newest_run]
-    events = []
-    for f in files:
-        opener = gzip.open if f.endswith(".gz") else open
-        with opener(f, "rb") as fh:
-            events.extend(json.loads(fh.read()).get("traceEvents", []))
-    return events, len(files)
+    host); a bare .json whose .gz sibling exists is skipped, not doubled.
+
+    The parser itself was hoisted into the package
+    (``observe.opcost.load_trace_events``) so in-process consumers — the
+    on-demand capture's post-fire ingest, bench.py's opcost block —
+    share it; this wrapper keeps the CLI's exit behavior."""
+    try:
+        return _opcost.load_trace_events(trace_dir)
+    except FileNotFoundError as e:
+        raise SystemExit(str(e))
 
 
 def summarize(events, top: int):
@@ -355,6 +340,23 @@ def main(argv=None):
         }))
         for r in rows:
             print(json.dumps(r))
+        # op-cost rollup: the same events bucketed by cost class
+        # (observe/opcost.py) — the stdout twin of the bench record's
+        # opcost block, so "did the collectives grow?" is answerable
+        # from a bare trace dir without running trace_diff
+        table = _opcost.op_table(op_events, top=opt.top)
+        if table["total_s"] > 0:
+            print(json.dumps({
+                "opcost_classes_ms": {
+                    cls: round(row["seconds"] * 1e3, 3)
+                    for cls, row in table["classes"].items()
+                    if row["events"]
+                },
+                "collectives_ms": {
+                    r["op"]: round(r["s"] * 1e3, 3)
+                    for r in table["collectives"]
+                },
+            }))
 
 
 if __name__ == "__main__":

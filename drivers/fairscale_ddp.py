@@ -165,6 +165,36 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
 
     probe = obs_num.probe_from_env()
     watchdog = obs_num.watchdog_from_env() if probe is not None else None
+    # --capture/$GRAFT_CAPTURE: arm the anomaly-triggered profiler on this
+    # driver's raw-step loop; with --opcost/$GRAFT_OPCOST a landed capture
+    # is parsed into the per-axis bandwidth gauges the fleet endpoint
+    # publishes (observe/capture.py + observe/opcost.py)
+    capture_prof = None
+    _cap_env = os.environ.get("GRAFT_CAPTURE", "")
+    if _cap_env.strip().lower() not in ("", "0", "false", "off", "no"):
+        from pytorch_distributedtraining_tpu.observe.capture import (
+            OnDemandProfiler,
+        )
+
+        _cap_dir = (
+            _cap_env.strip()
+            if _cap_env.strip().lower() not in ("1", "true", "on", "yes")
+            else None
+        )
+        _on_capture = None
+        if os.environ.get("GRAFT_OPCOST", "").strip().lower() not in (
+            "", "0", "false", "off", "no"
+        ):
+            from pytorch_distributedtraining_tpu.observe import (
+                opcost as opcost_mod,
+            )
+
+            def _on_capture(cap_dir, source):
+                opcost_mod.ingest_trace(cap_dir, mesh_axes=dict(mesh.shape))
+
+        capture_prof = OnDemandProfiler(
+            trace_dir=_cap_dir, on_capture=_on_capture
+        ).arm()
     if wire is not None and pp == 1:
         # MeshSpec.zero() puts every device on the sharded-DP axis, so
         # the quantized hop IS the fsdp axis here
@@ -244,6 +274,8 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
                     continue
                 state, metrics = step(state, batch)
                 loss = metrics["loss"]
+                if capture_prof is not None:
+                    capture_prof.note_step()
                 step_clean = True
                 if probe is not None and "numerics" in metrics:
                     summary = probe.observe(
@@ -360,6 +392,20 @@ def main(argv=None):
                              "rollback pairs with --ckpt to restore the last "
                              "committed step (bare --numerics = halt; env "
                              "twins $GRAFT_NUMERICS / $GRAFT_NUMERICS_ACTION)")
+    parser.add_argument("--opcost", action="store_true",
+                        default=bool(os.environ.get("GRAFT_OPCOST")),
+                        help="enable the op-cost attribution plane: a landed "
+                             "profiler capture is parsed into per-class cost "
+                             "tables + per-axis collective bandwidth gauges "
+                             "(env twin $GRAFT_OPCOST)")
+    parser.add_argument("--capture", type=str, nargs="?", const="1",
+                        default=os.environ.get("GRAFT_CAPTURE"),
+                        help="arm the anomaly-triggered profiler capture on "
+                             "the training loop (bounded jax.profiler trace "
+                             "on straggler/SLO/numerics/regression signals) "
+                             "— bare --capture writes under the run dir, "
+                             "--capture DIR writes there (env twin "
+                             "$GRAFT_CAPTURE)")
     opt = parser.parse_args(argv)
 
     if opt.trace is not None:
@@ -370,6 +416,13 @@ def main(argv=None):
     if opt.numerics:
         os.environ["GRAFT_NUMERICS"] = "1"
         os.environ["GRAFT_NUMERICS_ACTION"] = opt.numerics
+
+    if opt.opcost:
+        os.environ["GRAFT_OPCOST"] = "1"
+    if opt.capture and opt.capture.strip().lower() not in (
+        "", "0", "false", "off", "no"
+    ):
+        os.environ["GRAFT_CAPTURE"] = opt.capture
 
     # GRAFT_PLATFORM=cpu forces the backend (see runtime.dist docstring:
     # some images re-latch JAX_PLATFORMS before user code runs)

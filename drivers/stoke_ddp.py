@@ -274,6 +274,21 @@ def build_parser():
                              "watchdog. The value is the watchdog action "
                              "(bare --numerics = halt; env twins "
                              "$GRAFT_NUMERICS / $GRAFT_NUMERICS_ACTION)")
+    parser.add_argument("--opcost", action="store_true",
+                        default=bool(os.environ.get("GRAFT_OPCOST")),
+                        help="enable the op-cost attribution plane: after a "
+                             "profiler capture lands, parse it into per-class "
+                             "cost tables and per-axis collective bandwidth "
+                             "gauges (env twin $GRAFT_OPCOST)")
+    parser.add_argument("--capture", type=str, nargs="?", const="1",
+                        default=os.environ.get("GRAFT_CAPTURE"),
+                        help="arm the anomaly-triggered profiler capture: a "
+                             "bounded jax.profiler trace fires on straggler/"
+                             "SLO-burn/numerics/regression signals — bare "
+                             "--capture writes under the run dir, --capture "
+                             "DIR writes there (env twin $GRAFT_CAPTURE; "
+                             "composes with --opcost for the bandwidth "
+                             "ingest)")
     return parser
 
 
@@ -353,6 +368,19 @@ def main(argv=None):
         os.environ["GRAFT_NUMERICS"] = "1"
         os.environ["GRAFT_NUMERICS_ACTION"] = opt.numerics
         print(f"===> numerics plane on, watchdog action={opt.numerics}")
+
+    # --opcost/--capture thread the op-cost attribution plane through the
+    # env twins: the facade arms an OnDemandProfiler at construction and
+    # the post-capture hook feeds the per-axis bandwidth gauges
+    if opt.opcost:
+        os.environ["GRAFT_OPCOST"] = "1"
+        print("===> op-cost attribution on")
+    if opt.capture and opt.capture.strip().lower() not in (
+        "", "0", "false", "off", "no"
+    ):
+        os.environ["GRAFT_CAPTURE"] = opt.capture
+        print(f"===> anomaly capture armed "
+              f"(dir: {opt.capture if opt.capture != '1' else 'run dir'})")
 
     # --trace threads telemetry through its env twins: the facade enables
     # the tracer at construction; export happens after the epoch loop

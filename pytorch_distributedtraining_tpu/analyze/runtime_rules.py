@@ -322,6 +322,93 @@ def numerics_divergence(ctx):
         )
 
 
+def _opcost_stats():
+    """observe.opcost.runtime_stats via sys.modules — never imported
+    (stdlib-only module, same 'a live probe IS the signal' contract:
+    bandwidth/calibration stats only exist when something in this
+    process actually ingested a profiler trace)."""
+    oc = sys.modules.get(
+        "pytorch_distributedtraining_tpu.observe.opcost"
+    )
+    return getattr(oc, "runtime_stats", None)
+
+
+@rule(
+    "comm-bandwidth-degraded",
+    "runtime",
+    "a mesh axis's measured collective bandwidth fell below its best",
+)
+def comm_bandwidth_degraded(ctx):
+    stats = _opcost_stats()
+    if not stats:
+        return
+    try:
+        frac = float(os.environ.get("GRAFT_BW_DEGRADED_FRAC", "0.5") or 0.5)
+    except ValueError:
+        frac = 0.5
+    for axis, bw in (stats.get("axis_bandwidth") or {}).items():
+        best = (stats.get("axis_bandwidth_best") or {}).get(axis)
+        if not best or bw >= frac * best:
+            continue
+        yield Finding(
+            "comm-bandwidth-degraded",
+            Severity.WARN,
+            "runtime:opcost",
+            f"measured collective bandwidth on mesh axis {axis!r} is "
+            f"{bw / 1e9:.2f} GB/s — {bw / best:.0%} of the "
+            f"{best / 1e9:.2f} GB/s this process has seen on the same "
+            "axis. The links did not change; the traffic pattern or the "
+            "neighborhood did (congested DCN hop, a straggling peer "
+            "serializing the ring, or a layout change routing gradient "
+            "bytes over the slow axis). Check the per-axis gauges on the "
+            "fleet endpoint before trusting new step-time numbers",
+            evidence=(
+                f"axis={axis} bytes_per_s={bw:.3e} best={best:.3e} "
+                f"threshold_frac={frac}"
+            ),
+        )
+
+
+@rule(
+    "calibration-drift",
+    "runtime",
+    "an analytic cost model drifted from its measured calibration",
+)
+def calibration_drift(ctx):
+    stats = _opcost_stats()
+    if not stats:
+        return
+    try:
+        tol = float(
+            os.environ.get("GRAFT_CALIB_DRIFT_TOL", "0.5") or 0.5
+        )
+    except ValueError:
+        tol = 0.5
+    for name, row in (stats.get("calibration") or {}).items():
+        drift = row.get("drift")
+        if drift is None or abs(drift) <= tol:
+            continue
+        yield Finding(
+            "calibration-drift",
+            Severity.ERROR,
+            "runtime:opcost",
+            f"cost model {name!r} drifted {drift:+.0%} from its previous "
+            f"measured/analytic ratio ({row.get('ratio')} vs the last "
+            "calibration.json): every plan built on this model — wire "
+            "byte budgets, bubble-fraction schedules, MFU targets — is "
+            "now reasoning about a machine that no longer exists. "
+            "Re-measure (refresh calibration.json from a clean capture) "
+            "or find what changed under the model (compiler version, "
+            "mesh layout, dtype legalization)",
+            evidence=(
+                f"model={name} ratio={row.get('ratio')} "
+                f"drift={drift:+.4f} tol={tol} "
+                f"analytic={row.get('analytic')} "
+                f"measured={row.get('measured')} unit={row.get('unit')!r}"
+            ),
+        )
+
+
 @rule(
     "bench-regression",
     "runtime",

@@ -29,8 +29,18 @@ from .memory import (
     MemoryStats,
     compiled_memory_stats,
     device_hbm_budget,
+    host_memory_budget,
+    record_hbm_stats,
     tune_batch_size,
 )
+from . import opcost  # op-cost attribution plane (stdlib-only)
+from .opcost import (
+    calibrate,
+    collective_bandwidth,
+    load_trace_events,
+    op_table,
+)
+from .capture import OnDemandProfiler
 from . import trace  # the span-telemetry module (observe.trace)
 from .goodput import (
     GoodputLedger,
@@ -116,7 +126,15 @@ __all__ = [
     "MemoryStats",
     "compiled_memory_stats",
     "device_hbm_budget",
+    "host_memory_budget",
+    "record_hbm_stats",
     "tune_batch_size",
+    "opcost",
+    "load_trace_events",
+    "op_table",
+    "collective_bandwidth",
+    "calibrate",
+    "OnDemandProfiler",
     "fleet",
     "StreamHist",
     "ClockOffset",

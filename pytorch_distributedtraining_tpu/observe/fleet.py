@@ -605,6 +605,22 @@ def _serve_rolling_gauges() -> dict:
     return out
 
 
+def _opcost_rolling_gauges() -> dict:
+    """The op-cost plane's per-axis collective bandwidth + calibration
+    ratios (observe/opcost.py) — sys.modules, never imported, so a rank
+    that never ingested a profiler capture publishes nothing. Gauge
+    names arrive pre-labelled per axis (``collective_bw_bytes_per_s_dp``
+    etc.); the monitor adds the rank label like every other gauge."""
+    out: dict = {}
+    oc = sys.modules.get(
+        "pytorch_distributedtraining_tpu.observe.opcost"
+    )
+    for name, v in (getattr(oc, "rolling_gauges", None) or {}).items():
+        if isinstance(v, (int, float)):
+            out[f"opcost_{name}"] = float(v)
+    return out
+
+
 def _numerics_rolling_gauges() -> dict:
     """The training-numerics plane's health gauges (grad_norm,
     nonfinite_steps_total, fp8_amax_saturation, update ratios, wire
@@ -677,6 +693,7 @@ class RankMetricsPublisher:
         doc: dict = {"hists": {k: h.to_dict() for k, h in hists.items()}}
         gauges = _serve_rolling_gauges()
         gauges.update(_numerics_rolling_gauges())
+        gauges.update(_opcost_rolling_gauges())
         if gauges:
             doc["gauges"] = gauges
         if self.offset is not None:

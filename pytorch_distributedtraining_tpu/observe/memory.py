@@ -9,15 +9,18 @@ argument/output/temp/alias bytes per device. That makes two things cheap:
 - an auto-tuner can walk batch size up while the PROJECTED peak fits the
   device budget, instead of OOM-probing with real compiles + real steps.
 
-On CPU (tests, laptops) ``memory_stats()`` is unavailable → the budget must
-be passed explicitly; on TPU it comes from ``device.memory_stats()
-["bytes_limit"]``. This jaxlib's ``CompiledMemoryStats`` has no direct peak
+On CPU (tests, laptops) ``memory_stats()`` is unavailable →
+:func:`device_hbm_budget` falls back to total host RAM (documented
+stand-in; ``fallback=None`` restores the strict None, which
+:func:`tune_batch_size` keeps so it never guesses); on TPU it comes from
+``device.memory_stats()["bytes_limit"]``. This jaxlib's ``CompiledMemoryStats`` has no direct peak
 field, so peak is derived as ``argument + output + temp − alias`` (aliased
 donated buffers are counted once).
 """
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, asdict
 from typing import Callable
 
@@ -74,19 +77,97 @@ def compiled_memory_stats(compiled) -> MemoryStats | None:
     )
 
 
-def device_hbm_budget(device=None) -> int | None:
-    """Per-device memory capacity in bytes, or None when the runtime
-    doesn't report one (CPU): callers must then pass a budget explicitly."""
+# crash-flight-record block (observe/trace.py reads this via
+# sys.modules, never an import): the last HBM budget/high-water this
+# process observed, refreshed by record_hbm_stats(). A crash mid-OOM
+# then carries its memory story the way it carries its numerics story.
+runtime_stats: dict = {
+    "hbm_budget_bytes": None,      # device bytes_limit (or host fallback)
+    "hbm_high_water_bytes": None,  # device peak_bytes_in_use when reported
+    "hbm_in_use_bytes": None,      # device bytes_in_use when reported
+    "projected_peak_bytes": None,  # last compiled_memory_stats peak seen
+    "budget_source": None,         # "device" | "host-fallback"
+}
+
+# sentinel: "fall back to host RAM" (the documented CPU default); pass
+# fallback=None to restore the old None-propagating behavior
+_HOST_FALLBACK = "host"
+
+
+def host_memory_budget() -> int | None:
+    """Total physical host memory in bytes (``sysconf``), or None where
+    the platform doesn't report it — the documented CPU-backend stand-in
+    for an HBM limit."""
+    try:
+        page = os.sysconf("SC_PAGE_SIZE")
+        pages = os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError, AttributeError):
+        return None
+    if page <= 0 or pages <= 0:
+        return None
+    return int(page) * int(pages)
+
+
+def device_hbm_budget(device=None, *, fallback=_HOST_FALLBACK) -> int | None:
+    """Per-device memory capacity in bytes.
+
+    On backends that report ``memory_stats()`` (TPU) this is
+    ``bytes_limit``. On CPU — where jax reports nothing — the default is
+    the **total physical host RAM** (:func:`host_memory_budget`): the
+    process genuinely cannot allocate more than that, so arithmetic
+    built on the budget (utilization fractions, headroom) stays finite
+    instead of None-propagating into callers. Pass ``fallback=None`` to
+    get the old strict behavior (None when the runtime reports nothing —
+    what :func:`tune_batch_size` uses, so it still refuses to guess), or
+    an int to substitute an explicit stand-in.
+    """
+    if fallback is _HOST_FALLBACK:
+        fallback = host_memory_budget()
+
+    def _fallback():
+        runtime_stats["hbm_budget_bytes"] = fallback
+        runtime_stats["budget_source"] = (
+            "host-fallback" if fallback is not None else None
+        )
+        return fallback
+
     if device is None:
         device = jax.devices()[0]
     try:
         stats = device.memory_stats()
     except Exception:
-        return None
+        return _fallback()
     if not stats:
-        return None
+        return _fallback()
     limit = stats.get("bytes_limit")
-    return int(limit) if limit else None
+    if not limit:
+        return _fallback()
+    runtime_stats["hbm_budget_bytes"] = int(limit)
+    runtime_stats["budget_source"] = "device"
+    return int(limit)
+
+
+def record_hbm_stats(device=None, projected_peak_bytes: int | None = None) -> dict:
+    """Refresh :data:`runtime_stats` with the device's current memory
+    stats (high-water ``peak_bytes_in_use`` where the backend reports
+    it) for the crash flight record. Returns the refreshed dict; never
+    raises — accounting must not kill a run."""
+    try:
+        device_hbm_budget(device)
+        if device is None:
+            device = jax.devices()[0]
+        stats = device.memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        used = stats.get("bytes_in_use")
+        if peak is not None:
+            runtime_stats["hbm_high_water_bytes"] = int(peak)
+        if used is not None:
+            runtime_stats["hbm_in_use_bytes"] = int(used)
+    except Exception:  # noqa: BLE001
+        pass
+    if projected_peak_bytes is not None:
+        runtime_stats["projected_peak_bytes"] = int(projected_peak_bytes)
+    return dict(runtime_stats)
 
 
 def tune_batch_size(
@@ -108,7 +189,10 @@ def tune_batch_size(
     candidates cost compile time, not an OOM crash.
     """
     if budget_bytes is None:
-        budget_bytes = device_hbm_budget()
+        # strict mode (fallback=None): tuning against "all of host RAM"
+        # would walk the batch into swap-death territory on CPU — keep
+        # the never-guess contract and make the caller pass a budget
+        budget_bytes = device_hbm_budget(fallback=None)
     if budget_bytes is None:
         raise ValueError(
             "no device memory budget: pass budget_bytes= explicitly "

@@ -1,0 +1,463 @@
+"""Op-cost attribution: profiler traces → per-class cost tables,
+per-axis collective bandwidth, and analytic-model calibration.
+
+The fleet regression sentry (observe/fleet.py) can flag *that* a
+headline metric regressed but not *why*. This module closes that gap by
+turning a ``jax.profiler`` trace (the Chrome trace-event JSON every
+capture writes next to the xplane protobuf) into accounting the rest of
+the repo can reason about:
+
+- :func:`op_table`: per-op-class cost table — compute / collective /
+  copy / host-transfer — plus the per-collective rows the regression
+  attributor (benchmarks/trace_diff.py) diffs.
+- :func:`collective_bandwidth`: join the trace's collective seconds
+  against the HLO wire inventory's byte counts (observe/hlo.py
+  ``wire_inventory``) to get *measured* bytes-per-second per mesh axis —
+  the number the hierarchical-mesh planner needs and the
+  ``comm-bandwidth-degraded`` runtime rule watches.
+- :func:`calibrate` / :func:`write_calibration`: score the repo's
+  analytic cost models (``CompressedGradStep.wire_cost`` /
+  ``TrainStep.comm_cost`` bytes, pipeline ``bubble_fraction``, the MFU
+  FLOP model) against measured time, with a per-model ratio and drift
+  vs the previous calibration — the artifact a future AOT auto-planner
+  consumes (``calibration.json``).
+
+:func:`load_trace_events` is the loader ``benchmarks/trace_summary.py``
+grew first; it is hoisted here so both the CLI and the in-process
+consumers (the on-demand capture's post-fire hook, the bench's opcost
+block) share one parser. The module is stdlib-only at import — the
+graftcheck runtime plane and the fleet publisher read ``runtime_stats``
+/ ``rolling_gauges`` through ``sys.modules``, never by importing it.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+
+__all__ = [
+    "load_trace_events",
+    "op_class",
+    "op_table",
+    "collective_bandwidth",
+    "calibrate",
+    "write_calibration",
+    "load_calibration",
+    "ingest_trace",
+    "runtime_stats",
+    "rolling_gauges",
+    "reset",
+]
+
+# read by analyze/runtime_rules.py (comm-bandwidth-degraded,
+# calibration-drift) via sys.modules — never imported there
+runtime_stats: dict = {
+    "tables_built": 0,          # op_table() calls this process
+    "axis_bandwidth": {},       # axis -> latest measured bytes/s
+    "axis_bandwidth_best": {},  # axis -> best bytes/s ever seen here
+    "calibration": {},          # latest calibrate() result, by model
+}
+
+# read by observe/fleet.py's RankMetricsPublisher via sys.modules; names
+# become Prometheus gauges (the monitor adds the rank label)
+rolling_gauges: dict = {}
+
+
+def reset() -> None:
+    """Restore module gauges to import-time state (process-global on
+    purpose — consumers read them via ``sys.modules`` — so tests and
+    fresh runs re-arm them explicitly)."""
+    runtime_stats.update(
+        tables_built=0,
+        axis_bandwidth={},
+        axis_bandwidth_best={},
+        calibration={},
+    )
+    rolling_gauges.clear()
+
+
+# -- trace loading ------------------------------------------------------
+
+_SCAFFOLD = (
+    "block_until_ready", "try_to_block", "ThunkExecutor", "trace",
+    "stop_trace", "__exit__",
+)
+
+
+def load_trace_events(trace_dir: str):
+    """All events from every trace file under ``trace_dir`` (multi-host
+    dirs have one per host); a bare .json whose .gz sibling exists is
+    skipped, not doubled. Returns ``(events, n_files)``.
+
+    Hoisted from ``benchmarks/trace_summary.py:load_events`` — the CLI
+    now delegates here. Raises :class:`FileNotFoundError` when the dir
+    holds no trace files (the CLI converts that to its SystemExit).
+    """
+    pats = [
+        os.path.join(trace_dir, "**", "*.trace.json.gz"),
+        os.path.join(trace_dir, "**", "*.trace.json"),
+    ]
+    files = sorted(
+        f for pat in pats for f in glob.glob(pat, recursive=True)
+    )
+    files = [f for f in files if not (
+        f.endswith(".json") and f + ".gz" in files
+    )]
+    if not files:
+        raise FileNotFoundError(f"no *.trace.json(.gz) under {trace_dir}")
+    # one profiling RUN = one timestamped parent dir; merge only the
+    # newest run's files (multi-host: one file per host) — summing
+    # several runs would silently multiply every op time
+    newest_run = max(os.path.dirname(f) for f in files)
+    files = [f for f in files if os.path.dirname(f) == newest_run]
+    events = []
+    for f in files:
+        opener = gzip.open if f.endswith(".gz") else open
+        with opener(f, "rb") as fh:
+            events.extend(json.loads(fh.read()).get("traceEvents", []))
+    return events, len(files)
+
+
+# -- op classification --------------------------------------------------
+
+# prefixes matched against the (fusion-suffix-stripped) HLO op name;
+# first hit wins, anything unmatched is compute. "-start"/"-done" async
+# halves share the base prefix, so they land in the same class.
+_CLASS_PREFIXES = (
+    ("collective", (
+        "all-reduce", "reduce-scatter", "all-gather", "all-to-all",
+        "collective-permute", "collective-broadcast", "partition-id",
+        "replica-id",
+    )),
+    ("copy", ("copy",)),
+    ("host-transfer", (
+        "infeed", "outfeed", "send", "recv", "host", "transfer",
+    )),
+)
+
+OP_CLASSES = ("compute", "collective", "copy", "host-transfer")
+
+
+def op_class(name: str) -> str:
+    """Cost class of one HLO op name (compute / collective / copy /
+    host-transfer). Fusion families keep their head's class."""
+    base = name.split(".", 1)[0].strip().lower()
+    for cls, prefixes in _CLASS_PREFIXES:
+        if base.startswith(prefixes):
+            return cls
+    return "compute"
+
+
+def op_table(events, top: int = 25) -> dict:
+    """Per-op-class cost table from profiler trace events.
+
+    Same lane discipline as ``trace_summary.summarize``: device lanes
+    preferred over host lanes, TensorBoard op-thread lanes preferred
+    over Module/Step envelope lanes, ``$``-named python scaffolding and
+    block_until_ready frames excluded, fusion families grouped
+    (``name.N`` → ``name.*``). Durations are reported in seconds.
+    """
+    lanes, threads = {}, {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            lanes[e["pid"]] = e.get("args", {}).get("name", str(e["pid"]))
+        elif e.get("name") == "thread_name":
+            threads[(e["pid"], e.get("tid"))] = e.get("args", {}).get(
+                "name", ""
+            )
+
+    device_pids = {
+        pid for pid, name in lanes.items()
+        if "host" not in (name or "").lower()
+    }
+    use_pids = device_pids or set(lanes)
+    op_tids = {
+        key for key, name in threads.items()
+        if key[0] in use_pids
+        and (name or "").strip().lower() in ("xla ops", "tensorflow ops")
+    }
+
+    def _lane_ok(e):
+        if e.get("pid") not in use_pids:
+            return False
+        if op_tids:
+            return (e.get("pid"), e.get("tid")) in op_tids
+        name = threads.get((e.get("pid"), e.get("tid")), "")
+        return not any(s in name for s in ("Module", "Step"))
+
+    dur = collections.Counter()
+    n_ev = collections.Counter()
+    for e in events:
+        if e.get("ph") != "X" or not _lane_ok(e):
+            continue
+        name = e.get("name", "?")
+        if name.startswith("$") or any(s in name for s in _SCAFFOLD):
+            continue
+        head, _, tail = name.rpartition(".")
+        if head and tail.isdigit():
+            name = head + ".*"
+        dur[name] += e.get("dur", 0.0)  # microseconds
+        n_ev[name] += 1
+
+    classes = {
+        cls: {"seconds": 0.0, "events": 0} for cls in OP_CLASSES
+    }
+    collectives = collections.Counter()
+    coll_events = collections.Counter()
+    for name, us in dur.items():
+        cls = op_class(name)
+        classes[cls]["seconds"] += us / 1e6
+        classes[cls]["events"] += n_ev[name]
+        if cls == "collective":
+            base = name.split(".", 1)[0]
+            collectives[base] += us / 1e6
+            coll_events[base] += n_ev[name]
+    for row in classes.values():
+        row["seconds"] = round(row["seconds"], 9)
+    total = sum(dur.values())
+    table = {
+        "total_s": round(total / 1e6, 9),
+        "classes": classes,
+        "ops": [
+            {
+                "op": name,
+                "class": op_class(name),
+                "s": round(us / 1e6, 9),
+                "share": round(us / total, 4) if total else 0.0,
+            }
+            for name, us in dur.most_common(top)
+        ],
+        "collectives": [
+            {
+                "op": name,
+                "s": round(s, 9),
+                "events": coll_events[name],
+            }
+            for name, s in collectives.most_common()
+        ],
+    }
+    runtime_stats["tables_built"] += 1
+    return table
+
+
+# -- collective bandwidth: trace seconds x HLO bytes --------------------
+
+# HLO dtype-token widths for the wire-inventory byte join; tokens the
+# table misses are charged at 4 bytes (f32 — the conservative default)
+_DTYPE_BYTES = {
+    "f64": 8, "s64": 8, "u64": 8, "c64": 8,
+    "f32": 4, "s32": 4, "u32": 4,
+    "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1,
+    "f8e4m3fn": 1, "f8e4m3": 1, "f8e4m3b11fnuz": 1, "f8e5m2": 1,
+}
+
+
+def dtype_bytes(token: str) -> int:
+    return _DTYPE_BYTES.get((token or "").lower(), 4)
+
+
+def _group_size(line: str) -> int | None:
+    """Participant count of one collective, from its HLO
+    ``replica_groups`` attribute. Handles both the explicit form
+    ``replica_groups={{0,1},{2,3}}`` (size = members of the first group)
+    and the iota form ``replica_groups=[G,S]<=[N]`` (size = S). None
+    when the line carries no parsable groups (flat/implicit grouping).
+    """
+    if "replica_groups=" not in line:
+        return None
+    attr = line.split("replica_groups=", 1)[1]
+    if attr.startswith("{{"):
+        first = attr[2:].split("}", 1)[0]
+        members = [t for t in first.split(",") if t.strip() != ""]
+        return len(members) or None
+    if attr.startswith("["):
+        dims = attr[1:].split("]", 1)[0]
+        try:
+            parts = [int(t) for t in dims.split(",") if t.strip()]
+        except ValueError:
+            return None
+        return parts[-1] if parts else None
+    return None
+
+
+def wire_bytes(wire) -> int:
+    """Per-partition payload bytes of one ``WireCollective``."""
+    return int(wire.elems) * dtype_bytes(wire.dtype)
+
+
+def collective_bandwidth(
+    table: dict, wires, mesh_axes: dict, steps: int = 1,
+) -> dict:
+    """Measured bytes-per-second per mesh axis.
+
+    ``table`` is an :func:`op_table`; ``wires`` is the compiled step's
+    ``observe.hlo.wire_inventory``; ``mesh_axes`` maps axis name → size;
+    ``steps`` is how many step executions the trace covers (the HLO
+    inventory is per execution, the trace seconds are cumulative).
+
+    Each collective is attributed to the mesh axis whose size matches
+    its ``replica_groups`` participant count (group size); collectives
+    with no parsable groups, or a group size no axis matches, land under
+    ``"?"``. The trace does not label events with axes, so each
+    collective *kind*'s measured seconds are apportioned across axes by
+    that kind's byte share per axis — exact when a kind runs on one
+    axis (the common layouts), an explicit approximation otherwise.
+    """
+    # per-kind bytes split by axis (from the HLO side)
+    bytes_by_kind_axis: dict = collections.defaultdict(collections.Counter)
+    sizes = {int(v): k for k, v in mesh_axes.items() if int(v) > 1}
+    for w in wires:
+        gsz = _group_size(w.line)
+        axis = sizes.get(gsz, "?") if gsz is not None else "?"
+        if axis == "?" and len(sizes) == 1:
+            # one non-trivial axis: every collective belongs to it
+            axis = next(iter(sizes.values()))
+        bytes_by_kind_axis[w.kind][axis] += wire_bytes(w)
+    # per-kind measured seconds (from the trace side); async halves
+    # ("all-gather-start") share their base kind
+    secs_by_kind = collections.Counter()
+    for row in table.get("collectives", []):
+        kind = row["op"]
+        for suffix in ("-start", "-done"):
+            if kind.endswith(suffix):
+                kind = kind[: -len(suffix)]
+        secs_by_kind[kind] += row["s"]
+    out: dict = {}
+    for kind, by_axis in bytes_by_kind_axis.items():
+        kind_bytes = sum(by_axis.values())
+        kind_s = secs_by_kind.get(kind, 0.0)
+        for axis, b in by_axis.items():
+            row = out.setdefault(
+                axis, {"bytes": 0, "seconds": 0.0, "bytes_per_s": None}
+            )
+            row["bytes"] += b * max(1, int(steps))
+            if kind_bytes > 0 and kind_s > 0:
+                row["seconds"] += kind_s * (b / kind_bytes)
+    for axis, row in out.items():
+        if row["seconds"] > 0:
+            row["bytes_per_s"] = row["bytes"] / row["seconds"]
+            row["seconds"] = round(row["seconds"], 9)
+    _note_bandwidth(out)
+    return out
+
+
+def _note_bandwidth(per_axis: dict) -> None:
+    """Fold measured per-axis bandwidth into the module gauges (the
+    fleet publisher and the comm-bandwidth-degraded rule read these)."""
+    for axis, row in per_axis.items():
+        bw = row.get("bytes_per_s")
+        if not bw or axis == "?":
+            continue
+        runtime_stats["axis_bandwidth"][axis] = float(bw)
+        best = runtime_stats["axis_bandwidth_best"].get(axis, 0.0)
+        runtime_stats["axis_bandwidth_best"][axis] = max(best, float(bw))
+        rolling_gauges[f"collective_bw_bytes_per_s_{axis}"] = float(bw)
+
+
+# -- analytic-model calibration -----------------------------------------
+
+
+def calibrate(models: dict, previous: dict | None = None) -> dict:
+    """Score analytic predictions against measurements.
+
+    ``models`` maps model name → ``{"analytic": x, "measured": y,
+    "unit": u}`` (e.g. ``mfu_flops`` in seconds, ``wire`` in bytes,
+    ``bubble`` as a fraction). Returns the same keys with ``ratio``
+    (measured / analytic — 1.0 means the model is exact, 2.0 means
+    reality is twice the prediction) and ``drift`` (relative change of
+    the ratio vs ``previous``'s entry for the same model, None on first
+    sight). Entries whose analytic side is missing or non-positive are
+    dropped — a ratio against zero is noise, not calibration.
+
+    The result also lands in ``runtime_stats["calibration"]`` so the
+    ``calibration-drift`` runtime rule sees it without an import.
+    """
+    out: dict = {}
+    previous = previous or {}
+    for name, row in models.items():
+        analytic = row.get("analytic")
+        measured = row.get("measured")
+        if (
+            analytic is None or measured is None
+            or not analytic > 0 or measured < 0
+        ):
+            continue
+        ratio = float(measured) / float(analytic)
+        drift = None
+        prev = previous.get(name) or {}
+        prev_ratio = prev.get("ratio")
+        if prev_ratio:
+            drift = round(ratio / float(prev_ratio) - 1.0, 6)
+        out[name] = {
+            "analytic": float(analytic),
+            "measured": float(measured),
+            "unit": row.get("unit", ""),
+            "ratio": round(ratio, 6),
+            "drift": drift,
+        }
+    runtime_stats["calibration"] = out
+    for name, row in out.items():
+        rolling_gauges[f"calibration_ratio_{name}"] = row["ratio"]
+    return out
+
+
+def write_calibration(path: str, calibration: dict, meta: dict | None = None) -> str:
+    """Write ``calibration.json`` (atomic; the planner-facing artifact)."""
+    doc = {"calibration": calibration}
+    if meta:
+        doc["meta"] = meta
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def load_calibration(path: str) -> dict | None:
+    """Read a previous ``calibration.json``'s per-model table (None when
+    missing/unreadable — first runs have no drift baseline)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return doc.get("calibration") if isinstance(doc, dict) else None
+
+
+def ingest_trace(
+    trace_dir: str,
+    *,
+    hlo_text: str | None = None,
+    mesh_axes: dict | None = None,
+    steps: int = 1,
+    top: int = 25,
+) -> dict | None:
+    """Parse one profiler capture into the module gauges.
+
+    The on-demand capture's post-fire hook and the stoke facade call
+    this: load the newest run under ``trace_dir``, build the op table,
+    and — when the caller can supply the compiled HLO — join the
+    collective bandwidth per axis. Returns ``{"table", "bandwidth"}``
+    or None when the dir holds no trace (a capture that failed to
+    flush must not raise out of an anomaly handler).
+    """
+    try:
+        events, _ = load_trace_events(trace_dir)
+    except (FileNotFoundError, OSError, json.JSONDecodeError):
+        return None
+    table = op_table(events, top=top)
+    bandwidth = None
+    if hlo_text is not None and mesh_axes:
+        from .hlo import wire_inventory
+
+        bandwidth = collective_bandwidth(
+            table, wire_inventory(hlo_text), mesh_axes, steps=steps
+        )
+    return {"table": table, "bandwidth": bandwidth}

@@ -14,18 +14,79 @@ from __future__ import annotations
 
 import contextlib
 import time
+import warnings
 from dataclasses import dataclass, field
+
+# the profiler is a process-global singleton in jax: a second
+# start_trace raises. This module owns the arbitration so the manual
+# --trace context manager and the on-demand anomaly capture
+# (observe/capture.py) can coexist — whoever starts first wins, the
+# second entrant becomes a no-op with a WARN instant.
+_ACTIVE: dict = {"logdir": None}
+
+
+def profiler_active() -> str | None:
+    """The logdir of the trace this module started, or None."""
+    return _ACTIVE["logdir"]
+
+
+def _note_reentrant(logdir: str) -> None:
+    warnings.warn(
+        f"jax profiler trace already active (-> {_ACTIVE['logdir']!r}); "
+        f"request for {logdir!r} is a no-op",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+    from . import trace as _telemetry
+
+    if _telemetry.enabled():
+        _telemetry.instant(
+            "profiler.reentrant", "profile",
+            active=_ACTIVE["logdir"], requested=logdir,
+        )
+
+
+def start_profiler_trace(logdir: str) -> bool:
+    """Guarded ``jax.profiler.start_trace``: True when this call started
+    a trace, False when one was already active (no-op + WARN instant —
+    never the RuntimeError jax raises on re-entry)."""
+    if _ACTIVE["logdir"] is not None:
+        _note_reentrant(logdir)
+        return False
+    import jax
+
+    try:
+        jax.profiler.start_trace(logdir)
+    except RuntimeError:
+        # someone started a trace through the raw jax API, bypassing
+        # this guard — same verdict as the guarded case
+        _note_reentrant(logdir)
+        return False
+    _ACTIVE["logdir"] = logdir
+    return True
+
+
+def stop_profiler_trace() -> None:
+    """Stop the trace :func:`start_profiler_trace` started (no-op when
+    this module owns none — never stops someone else's trace)."""
+    if _ACTIVE["logdir"] is None:
+        return
+    import jax
+
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        _ACTIVE["logdir"] = None
 
 
 @contextlib.contextmanager
 def trace(logdir: str = "/tmp/jax-trace"):
-    import jax
-
-    jax.profiler.start_trace(logdir)
+    started = start_profiler_trace(logdir)
     try:
         yield logdir
     finally:
-        jax.profiler.stop_trace()
+        if started:
+            stop_profiler_trace()
 
 
 @dataclass

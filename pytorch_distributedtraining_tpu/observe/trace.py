@@ -576,6 +576,16 @@ def flush_flight_record(
             num_snap = num_mod.snapshot()
             if num_snap.get("steps_observed"):
                 doc["numerics"] = num_snap
+        # the memory half: HBM budget + high-water at the moment of
+        # death (a crash mid-OOM keeps its memory story). Same
+        # sys.modules contract — observe.memory imports jax, and a
+        # flight flush must never be the thing that initializes it.
+        mem_mod = sys.modules.get(
+            "pytorch_distributedtraining_tpu.observe.memory"
+        )
+        mem_stats = getattr(mem_mod, "runtime_stats", None)
+        if mem_stats and any(v is not None for v in mem_stats.values()):
+            doc["memory"] = dict(mem_stats)
         if exc is not None:
             doc["exception"] = {
                 "type": type(exc).__name__,

@@ -455,6 +455,44 @@ class TrainStep:
                 .as_text()
             )
 
+    def comm_cost(self, params) -> dict:
+        """Analytic bytes-on-wire for the grad hop of one step — the f32
+        twin of ``CompressedGradStep.wire_cost`` (same hop convention: a
+        reduce-scatter moves n bytes per shard, an all-reduce 2n for the
+        reduce + gather hops). Leaves below the policy's
+        ``min_shard_size`` floor stay replicated and pay the all-reduce
+        rate even under ``shard_grads``. Feeds the opcost plane's "wire"
+        calibration model (analytic bytes vs HLO-measured bytes).
+        """
+        from .spec import leaf_spec, shard_axis
+
+        ax = shard_axis(self.mesh)
+        size = int(self.mesh.shape.get(ax, 1)) if ax else 1
+        if ax is None or size <= 1:
+            return {
+                "collective": None,
+                "fp32_bytes": 0,
+                "axis": None,
+                "axis_size": 1,
+            }
+        rs = bool(self.policy.shard_grads)
+        total = 0
+        for p in jax.tree.leaves(params):
+            n = 1
+            for s in p.shape:
+                n *= int(s)
+            scattered = rs and leaf_spec(
+                p.shape, ax, size, self.policy.min_shard_size
+            ) != PartitionSpec()
+            hops = 1 if scattered else 2
+            total += hops * n * 4
+        return {
+            "collective": "reduce-scatter" if rs else "all-reduce",
+            "fp32_bytes": int(total),
+            "axis": ax,
+            "axis_size": size,
+        }
+
     def memory_analysis(self, state: TrainState, batch, lr_factor: float = 1.0):
         """Compiler memory accounting for this step (`observe.memory`).
 

@@ -117,6 +117,18 @@ class TPUConfig:
     # $GRAFT_NUMERICS, $GRAFT_NUMERICS_ACTION.
     numerics: bool = False
     numerics_action: str = "halt"
+    # Op-cost attribution plane (observe/opcost.py): after a profiler
+    # capture lands, parse it into per-class cost tables + per-axis
+    # collective bandwidth gauges (published through the fleet
+    # endpoint). Env twin: $GRAFT_OPCOST.
+    opcost: bool = False
+    # Anomaly-triggered profiler capture (observe/capture.py): arm a
+    # bounded jax.profiler capture that fires on straggler / SLO-burn /
+    # numerics / regression signals. ``capture_dir`` is where captures
+    # land (default: under the run dir). Env twin: $GRAFT_CAPTURE — "0"
+    # off, "1" on, any other value = on with that capture dir.
+    capture: bool = False
+    capture_dir: str | None = None
 
 
 @dataclass

@@ -186,6 +186,32 @@ def _numerics_from_env(cfg):
     return on, action
 
 
+def _opcost_from_env(cfg):
+    """Resolve the op-cost plane: ``$GRAFT_OPCOST`` overrides
+    ``TPUConfig.opcost`` (same env-twin pattern as GRAFT_NUMERICS)."""
+    env = os.environ.get("GRAFT_OPCOST")
+    if env is not None:
+        return env.strip().lower() not in ("", "0", "false", "off", "no")
+    return bool(cfg.opcost)
+
+
+def _capture_from_env(cfg):
+    """Resolve the anomaly-triggered capture: ``$GRAFT_CAPTURE``
+    overrides ``TPUConfig.capture``; a value that is neither a boolean
+    spelling nor empty is the capture directory (on + dir), overriding
+    ``TPUConfig.capture_dir``. Returns ``(enabled, capture_dir)``."""
+    cap_dir = cfg.capture_dir
+    env = os.environ.get("GRAFT_CAPTURE")
+    if env is None:
+        return bool(cfg.capture), cap_dir
+    v = env.strip()
+    if v.lower() in ("", "0", "false", "off", "no"):
+        return False, cap_dir
+    if v.lower() not in ("1", "true", "on", "yes"):
+        cap_dir = v
+    return True, cap_dir
+
+
 def _telemetry_from_env(cfg):
     """Resolve the telemetry switch: ``$GRAFT_TELEMETRY`` overrides
     ``TPUConfig.telemetry`` (deploy-time twin, same pattern as GRAFT_WIRE);
@@ -537,6 +563,33 @@ class Stoke:
             1, int(os.environ.get("GRAFT_NUMERICS_EVERY", "1") or 1)
         )
         self._numerics_count = 0
+        # op-cost attribution + anomaly-triggered capture (env >
+        # TPUConfig): an armed OnDemandProfiler polls the anomaly
+        # sources once per fused step (dict reads — priced inside the 1%
+        # telemetry budget by bench.py); when a capture fires and the
+        # opcost plane is on, the post-fire hook parses it into the
+        # per-axis bandwidth gauges the fleet endpoint publishes
+        self.opcost = _opcost_from_env(self.tpu_config)
+        capture_on, capture_dir = _capture_from_env(self.tpu_config)
+        self.capture = None
+        if capture_on:
+            from ..observe.capture import OnDemandProfiler
+
+            on_capture = None
+            if self.opcost:
+                from ..observe import opcost as _opcost_mod
+
+                def on_capture(cap_dir, source):
+                    _opcost_mod.ingest_trace(
+                        cap_dir,
+                        hlo_text=self._compiled_hlo_text(),
+                        mesh_axes=dict(self.mesh.shape),
+                    )
+
+            self.capture = OnDemandProfiler(
+                trace_dir=capture_dir, on_capture=on_capture
+            ).arm()
+        self._last_batch = None  # host refs for the post-capture HLO join
 
         # -- distribution policy ------------------------------------------
         distributed = (
@@ -1445,7 +1498,31 @@ class Stoke:
         )
         self._note_loss(metrics["loss"])
         self._observe_numerics(metrics)
+        if self.capture is not None:
+            self._last_batch = (inputs, targets)
+            self.capture.note_step()
         return metrics
+
+    def _compiled_hlo_text(self) -> str | None:
+        """Compiled HLO of the fused step (a cache hit after the first
+        step) — the wire-inventory join source for the opcost ingest
+        hook. None before the first fused step or when lowering fails;
+        the hook then publishes op tables without the bandwidth join."""
+        if (
+            self._fused is None
+            or self._state is None
+            or self._last_batch is None
+        ):
+            return None
+        try:
+            inputs, targets = self._last_batch
+            return self._fused.compiled_text(
+                self._state,
+                (self._shard_batch(inputs), self._shard_batch(targets)),
+                lr_factor=self._opt_handle.lr,
+            )
+        except Exception:  # noqa: BLE001 — accounting must not kill a step
+            return None
 
     def _observe_numerics(self, metrics) -> None:
         """Decode the step's numerics aux at the configured cadence and

@@ -1,0 +1,735 @@
+"""Op-cost attribution plane: per-class cost tables, collective
+bandwidth, calibration math, the anomaly-triggered capture trigger
+matrix, and op-level regression attribution.
+
+Acceptance coverage for the op-cost PR:
+
+- ``observe.opcost``: trace-event loading (newest-run-only merge,
+  gz-sibling dedup), op classification + lane discipline, the
+  HLO-byte x trace-second bandwidth join per mesh axis, and the
+  ``calibrate``/``write_calibration`` ratio + drift contract.
+- ``observe.capture.OnDemandProfiler``: each of the four anomaly
+  sources fires exactly once per anomaly (re-baseline), with cooldown /
+  budget / disk refusals counted and the re-entrancy degradation
+  (profiler already owned -> no capture, nothing counted).
+- ``benchmarks/trace_diff.py``: a seeded slowdown is attributed to the
+  class that grew; record-vs-record attribution never raises.
+- graftcheck runtime rules: ``comm-bandwidth-degraded`` (WARN) and
+  ``calibration-drift`` (ERROR) read the module gauges via sys.modules.
+- satellites: the ``observe.profiling`` re-entrancy guard and the
+  ``device_hbm_budget`` documented host fallback.
+"""
+
+from __future__ import annotations
+
+import gzip
+import importlib.util
+import json
+import os
+import sys
+from types import SimpleNamespace
+
+import jax.numpy as jnp
+import optax
+import pytest
+
+from pytorch_distributedtraining_tpu.analyze import (
+    AnalysisContext,
+    Severity,
+    run_rules,
+)
+from pytorch_distributedtraining_tpu.observe import capture as cap
+from pytorch_distributedtraining_tpu.observe import fleet
+from pytorch_distributedtraining_tpu.observe import memory as mem
+from pytorch_distributedtraining_tpu.observe import numerics as num
+from pytorch_distributedtraining_tpu.observe import opcost
+from pytorch_distributedtraining_tpu.observe import profiling, slo
+from pytorch_distributedtraining_tpu.parallel import DDP, ZeRO2, TrainStep
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCHMARKS = os.path.join(REPO, "benchmarks")
+
+
+def _load_bench_module(name: str):
+    """Load a benchmarks/ script by file path (they import _bootstrap,
+    so the benchmarks dir is on sys.path only for the exec)."""
+    sys.path.insert(0, BENCHMARKS)
+    try:
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(BENCHMARKS, f"{name}.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(BENCHMARKS)
+    return mod
+
+
+trace_diff = _load_bench_module("trace_diff")
+
+
+@pytest.fixture(autouse=True)
+def _clean_opcost_state():
+    """Module gauges are process-global by design (consumers read them
+    through sys.modules) — scrub them around every test here."""
+    opcost.reset()
+    cap.reset()
+    yield
+    opcost.reset()
+    cap.reset()
+
+
+@pytest.fixture
+def clean_sources():
+    """Reset every anomaly-source ledger the capture plane polls."""
+    saved_slo = dict(slo.runtime_stats)
+    fleet.reset_runtime_stats()
+    num.reset()
+    slo.runtime_stats.update(burn_rate_peak=0.0, budget_remaining=None)
+    yield
+    fleet.reset_runtime_stats()
+    num.reset()
+    slo.runtime_stats.update(saved_slo)
+
+
+# -- synthetic trace events ---------------------------------------------
+
+
+def _meta(pid, name):
+    return {"ph": "M", "name": "process_name", "pid": pid,
+            "args": {"name": name}}
+
+
+def _tmeta(pid, tid, name):
+    return {"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+            "args": {"name": name}}
+
+
+def _op(pid, tid, name, dur_us):
+    return {"ph": "X", "pid": pid, "tid": tid, "name": name,
+            "ts": 0, "dur": dur_us}
+
+
+def _events():
+    """Two lanes (device + host), an op thread and a Module envelope
+    thread — the TPU xplane layout op_table must navigate."""
+    return [
+        _meta(1, "/host:CPU"),
+        _meta(2, "/device:TPU:0"),
+        _tmeta(2, 7, "XLA Ops"),
+        _tmeta(2, 9, "XLA Modules"),
+        _tmeta(1, 3, "XLA Ops"),
+        # device op lane: these and only these are counted
+        _op(2, 7, "fusion.1", 100.0),
+        _op(2, 7, "fusion.2", 300.0),
+        _op(2, 7, "all-reduce.1", 200.0),
+        _op(2, 7, "all-gather-start.2", 50.0),
+        _op(2, 7, "copy.3", 25.0),
+        _op(2, 7, "infeed.1", 10.0),
+        _op(2, 7, "$src.py:12", 999.0),         # python scaffolding
+        _op(2, 7, "block_until_ready", 999.0),  # host-wait scaffolding
+        _op(2, 9, "jit_step", 5000.0),          # Module envelope lane
+        _op(1, 3, "host-side-op", 999.0),       # host lane
+    ]
+
+
+def _write_trace(trace_dir, events, run="run0", host="host0"):
+    d = os.path.join(trace_dir, "plugins", "profile", run)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{host}.trace.json.gz")
+    with gzip.open(path, "wb") as fh:
+        fh.write(json.dumps({"traceEvents": events}).encode())
+    return path
+
+
+# -- op classification + tables -----------------------------------------
+
+
+class TestOpTable:
+    def test_op_class(self):
+        assert opcost.op_class("fusion.12") == "compute"
+        assert opcost.op_class("all-reduce.1") == "collective"
+        assert opcost.op_class("reduce-scatter") == "collective"
+        assert opcost.op_class("all-gather-start.2") == "collective"
+        assert opcost.op_class("collective-permute-done") == "collective"
+        assert opcost.op_class("copy.3") == "copy"
+        assert opcost.op_class("copy-done.1") == "copy"
+        assert opcost.op_class("infeed") == "host-transfer"
+        assert opcost.op_class("outfeed.2") == "host-transfer"
+        assert opcost.op_class("custom-call.7") == "compute"
+
+    def test_table_classes_and_lane_discipline(self):
+        t = opcost.op_table(_events())
+        # only the device op thread counts: 100+300+200+50+25+10 us
+        assert t["total_s"] == pytest.approx(685e-6)
+        assert t["classes"]["compute"]["seconds"] == pytest.approx(400e-6)
+        assert t["classes"]["collective"]["seconds"] == pytest.approx(250e-6)
+        assert t["classes"]["copy"]["seconds"] == pytest.approx(25e-6)
+        assert t["classes"]["host-transfer"]["seconds"] == pytest.approx(10e-6)
+        assert opcost.runtime_stats["tables_built"] == 1
+
+    def test_fusion_family_grouped(self):
+        t = opcost.op_table(_events())
+        fusion = next(r for r in t["ops"] if r["op"] == "fusion.*")
+        assert fusion["s"] == pytest.approx(400e-6)
+        assert fusion["class"] == "compute"
+
+    def test_collective_rows(self):
+        t = opcost.op_table(_events())
+        rows = {r["op"]: r["s"] for r in t["collectives"]}
+        assert rows == {
+            "all-reduce": pytest.approx(200e-6),
+            "all-gather-start": pytest.approx(50e-6),
+        }
+
+
+class TestLoadTraceEvents:
+    def test_missing_dir_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            opcost.load_trace_events(str(tmp_path))
+
+    def test_newest_run_only(self, tmp_path):
+        _write_trace(str(tmp_path), [_op(2, 7, "old-op", 1.0)], run="r000")
+        _write_trace(str(tmp_path), _events(), run="r001")
+        events, n_files = opcost.load_trace_events(str(tmp_path))
+        assert n_files == 1
+        names = {e.get("name") for e in events}
+        assert "fusion.1" in names and "old-op" not in names
+
+    def test_gz_sibling_dedup(self, tmp_path):
+        d = tmp_path / "plugins" / "profile" / "r0"
+        d.mkdir(parents=True)
+        doc = json.dumps({"traceEvents": [_op(2, 7, "x", 1.0)]}).encode()
+        (d / "h.trace.json").write_bytes(doc)
+        with gzip.open(d / "h.trace.json.gz", "wb") as fh:
+            fh.write(doc)
+        events, n_files = opcost.load_trace_events(str(tmp_path))
+        assert n_files == 1 and len(events) == 1
+
+    def test_trace_summary_delegates(self, tmp_path):
+        ts = _load_bench_module("trace_summary")
+        with pytest.raises(SystemExit):
+            ts.load_events(str(tmp_path))
+        _write_trace(str(tmp_path), _events())
+        events, _ = ts.load_events(str(tmp_path))
+        assert any(e.get("name") == "all-reduce.1" for e in events)
+
+
+# -- collective bandwidth: trace seconds x HLO bytes --------------------
+
+
+def _wire(kind, elems, line, dtype="f32"):
+    return SimpleNamespace(kind=kind, dtype=dtype, elems=elems, line=line)
+
+
+class TestCollectiveBandwidth:
+    def test_group_size_parsing(self):
+        assert opcost._group_size("replica_groups={{0,1},{2,3}}") == 2
+        assert opcost._group_size("replica_groups=[2,4]<=[8]") == 4
+        assert opcost._group_size("no groups here") is None
+
+    def test_axis_join_and_gauges(self):
+        table = {"collectives": [
+            {"op": "all-reduce-start", "s": 0.5, "events": 1},
+            {"op": "all-reduce-done", "s": 0.5, "events": 1},
+        ]}
+        wires = [_wire("all-reduce", 1000,
+                       "all-reduce(...) replica_groups={{0,1},{2,3}}")]
+        out = opcost.collective_bandwidth(
+            table, wires, {"dp": 2, "mp": 1}, steps=2
+        )
+        # 1000 f32 elems * 4 B * 2 steps over the start+done second
+        assert out["dp"]["bytes"] == 8000
+        assert out["dp"]["seconds"] == pytest.approx(1.0)
+        assert out["dp"]["bytes_per_s"] == pytest.approx(8000.0)
+        assert opcost.runtime_stats["axis_bandwidth"]["dp"] == 8000.0
+        assert (
+            opcost.rolling_gauges["collective_bw_bytes_per_s_dp"] == 8000.0
+        )
+
+    def test_single_axis_absorbs_unmatched(self):
+        table = {"collectives": [{"op": "all-gather", "s": 0.1,
+                                  "events": 1}]}
+        wires = [_wire("all-gather", 500, "all-gather(...) no groups")]
+        out = opcost.collective_bandwidth(table, wires, {"fsdp": 8})
+        assert list(out) == ["fsdp"]
+
+    def test_unmatched_lands_in_question_mark(self):
+        # two non-trivial axes and no parsable groups: honest "?"
+        table = {"collectives": [{"op": "all-gather", "s": 0.1,
+                                  "events": 1}]}
+        wires = [_wire("all-gather", 500, "all-gather(...)")]
+        out = opcost.collective_bandwidth(table, wires, {"dp": 2, "fsdp": 4})
+        assert list(out) == ["?"]
+        # "?" never becomes a gauge
+        assert opcost.runtime_stats["axis_bandwidth"] == {}
+
+    def test_best_bandwidth_sticks(self):
+        table = {"collectives": [{"op": "all-reduce", "s": 1.0,
+                                  "events": 1}]}
+        wires = [_wire("all-reduce", 1000, "replica_groups=[1,2]<=[2]")]
+        opcost.collective_bandwidth(table, wires, {"dp": 2})
+        slow = {"collectives": [{"op": "all-reduce", "s": 4.0,
+                                 "events": 1}]}
+        opcost.collective_bandwidth(slow, wires, {"dp": 2})
+        assert opcost.runtime_stats["axis_bandwidth"]["dp"] == 1000.0
+        assert opcost.runtime_stats["axis_bandwidth_best"]["dp"] == 4000.0
+
+
+# -- calibration --------------------------------------------------------
+
+
+class TestCalibrate:
+    def test_ratio_and_first_sight_drift(self):
+        out = opcost.calibrate({
+            "wire": {"analytic": 100.0, "measured": 200.0, "unit": "bytes"},
+        })
+        assert out["wire"]["ratio"] == 2.0
+        assert out["wire"]["drift"] is None
+        assert opcost.runtime_stats["calibration"] == out
+        assert opcost.rolling_gauges["calibration_ratio_wire"] == 2.0
+
+    def test_drift_vs_previous(self):
+        prev = {"wire": {"ratio": 2.0}}
+        out = opcost.calibrate(
+            {"wire": {"analytic": 100.0, "measured": 300.0,
+                      "unit": "bytes"}},
+            previous=prev,
+        )
+        assert out["wire"]["ratio"] == 3.0
+        assert out["wire"]["drift"] == pytest.approx(0.5)
+
+    def test_non_positive_analytic_dropped(self):
+        out = opcost.calibrate({
+            "zero": {"analytic": 0.0, "measured": 1.0},
+            "missing": {"measured": 1.0},
+            "negative-measured": {"analytic": 1.0, "measured": -1.0},
+            "good": {"analytic": 2.0, "measured": 1.0, "unit": "s"},
+        })
+        assert list(out) == ["good"]
+        assert out["good"]["ratio"] == 0.5
+
+    def test_write_load_roundtrip(self, tmp_path):
+        calp = str(tmp_path / "calibration.json")
+        calib = opcost.calibrate(
+            {"mfu_flops": {"analytic": 1.0, "measured": 2.0, "unit": "s"}}
+        )
+        opcost.write_calibration(calp, calib, meta={"metric": "img/s"})
+        loaded = opcost.load_calibration(calp)
+        assert loaded == calib
+        assert opcost.load_calibration(str(tmp_path / "nope.json")) is None
+        (tmp_path / "bad.json").write_text("{not json")
+        assert opcost.load_calibration(str(tmp_path / "bad.json")) is None
+
+    def test_ingest_trace(self, tmp_path):
+        _write_trace(str(tmp_path), _events())
+        got = opcost.ingest_trace(str(tmp_path))
+        assert got is not None and got["bandwidth"] is None
+        assert got["table"]["total_s"] > 0
+        # an empty capture dir must not raise out of an anomaly handler
+        assert opcost.ingest_trace(str(tmp_path / "empty")) is None
+
+
+# -- anomaly-triggered capture ------------------------------------------
+
+
+TRIPS = {
+    "fleet-straggler": lambda: fleet.runtime_stats.update(
+        stragglers_flagged=fleet.runtime_stats["stragglers_flagged"] + 1
+    ),
+    "slo-burn": lambda: slo.runtime_stats.update(burn_rate_peak=2.0),
+    "numerics": lambda: num.runtime_stats.update(
+        nonfinite_steps_total=num.runtime_stats["nonfinite_steps_total"] + 1
+    ),
+    "bench-regression": lambda: fleet.runtime_stats["verdicts"].append(
+        {"status": "regression"}
+    ),
+}
+
+
+def _mk_prof(tmp_path, **kw):
+    calls = {"start": [], "stop": 0}
+    clock = [0.0]
+
+    def start(d):
+        calls["start"].append(d)
+        os.makedirs(d, exist_ok=True)
+        return True
+
+    def stop():
+        calls["stop"] += 1
+
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("capture_steps", 1)
+    prof = cap.OnDemandProfiler(
+        str(tmp_path / "caps"), clock=lambda: clock[0],
+        start=kw.pop("start", start), stop=kw.pop("stop", stop), **kw,
+    )
+    return prof, calls, clock
+
+
+class TestCaptureTriggerMatrix:
+    @pytest.mark.parametrize("source", cap.TRIGGER_SOURCES)
+    def test_each_source_fires_exactly_once(
+        self, source, tmp_path, clean_sources
+    ):
+        prof, calls, clock = _mk_prof(tmp_path)
+        prof.arm()
+        assert cap.runtime_stats["armed"]
+        assert prof.note_step() is None  # healthy: four dict reads, quiet
+        TRIPS[source]()
+        assert prof.note_step() == source
+        assert prof.note_step() is None  # capture_steps=1 -> stop here
+        assert cap.runtime_stats["captures"] == 1
+        assert calls["stop"] == 1
+        assert f"-{source}" in cap.runtime_stats["capture_dirs"][0]
+        assert cap.runtime_stats["last_trigger"]["source"] == source
+        # re-baselined: the SAME anomaly never fires twice
+        clock[0] += 99.0
+        for _ in range(3):
+            assert prof.note_step() is None
+        assert cap.runtime_stats["captures"] == 1
+
+    def test_ok_verdicts_do_not_trip(self, tmp_path, clean_sources):
+        prof, _calls, _clock = _mk_prof(tmp_path)
+        prof.arm()
+        fleet.runtime_stats["verdicts"].append({"status": "ok"})
+        assert prof.note_step() is None
+
+    def test_budget_exhaustion_path_trips_slo(self, tmp_path, clean_sources):
+        prof, _calls, _clock = _mk_prof(tmp_path)
+        prof.arm()
+        slo.runtime_stats.update(budget_remaining=0.0)
+        assert prof.note_step() == "slo-burn"
+
+    def test_cooldown_refusal(self, tmp_path, clean_sources):
+        prof, _calls, clock = _mk_prof(tmp_path)
+        prof.arm()
+        TRIPS["fleet-straggler"]()
+        assert prof.note_step() == "fleet-straggler"
+        prof.note_step()  # finish
+        clock[0] = 5.0  # inside the 10 s cooldown
+        TRIPS["fleet-straggler"]()
+        assert prof.note_step() is None
+        assert cap.runtime_stats["refused_cooldown"] >= 1
+        clock[0] = 11.0
+        assert prof.note_step() == "fleet-straggler"
+
+    def test_budget_refusal(self, tmp_path, clean_sources):
+        prof, _calls, clock = _mk_prof(tmp_path, max_captures=1)
+        prof.arm()
+        TRIPS["numerics"]()
+        assert prof.note_step() == "numerics"
+        prof.note_step()
+        clock[0] = 99.0
+        TRIPS["numerics"]()
+        assert prof.note_step() is None
+        assert cap.runtime_stats["refused_budget"] >= 1
+        assert cap.runtime_stats["captures"] == 1
+
+    def test_disk_cap_refusal(self, tmp_path, clean_sources):
+        prof, _calls, _clock = _mk_prof(tmp_path, disk_cap_bytes=50)
+        os.makedirs(prof.trace_dir, exist_ok=True)
+        with open(os.path.join(prof.trace_dir, "junk"), "wb") as fh:
+            fh.write(b"x" * 100)
+        prof.arm()
+        TRIPS["fleet-straggler"]()
+        assert prof.note_step() is None
+        assert cap.runtime_stats["refused_disk"] == 1
+
+    def test_reentrancy_degrades_to_nothing(self, tmp_path, clean_sources):
+        # a manual trace owns the profiler: start returns False (the
+        # observe.profiling guard) — no capture, nothing counted
+        prof, _calls, clock = _mk_prof(tmp_path, start=lambda d: False)
+        prof.arm()
+        TRIPS["fleet-straggler"]()
+        assert prof.note_step() is None
+        assert cap.runtime_stats["captures"] == 0
+        assert prof.capturing is None
+        # the anomaly window recurs once the manual trace ends
+        prof._start = lambda d: True
+        clock[0] = 99.0
+        assert prof.note_step() == "fleet-straggler"
+
+    def test_on_capture_hook_and_error_swallow(
+        self, tmp_path, clean_sources
+    ):
+        seen = []
+        prof, _calls, _clock = _mk_prof(
+            tmp_path, on_capture=lambda d, s: seen.append((d, s))
+        )
+        prof.arm()
+        TRIPS["numerics"]()
+        src = prof.note_step()
+        prof.note_step()
+        assert seen == [(cap.runtime_stats["capture_dirs"][0], src)]
+        # a raising hook must not propagate into the training loop
+        prof2, _c, clock2 = _mk_prof(
+            tmp_path / "b", on_capture=lambda d, s: 1 / 0
+        )
+        prof2.arm()
+        TRIPS["numerics"]()
+        prof2.note_step()
+        prof2.note_step()  # _finish runs the hook; must not raise
+        assert cap.runtime_stats["captures"] == 2
+
+    def test_default_dir_under_run_dir(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("GRAFT_RUN_DIR", str(tmp_path))
+        prof = cap.OnDemandProfiler()
+        assert prof.trace_dir == os.path.join(str(tmp_path), "captures")
+
+    def test_summary_shape(self, tmp_path, clean_sources):
+        prof, _calls, _clock = _mk_prof(tmp_path)
+        s = prof.arm().summary()
+        assert s["armed"] and s["captures"] == 0
+        assert s["refused"] == {"cooldown": 0, "budget": 0, "disk": 0}
+
+
+# -- regression attribution (trace_diff) --------------------------------
+
+
+def _rec(collective_s, compute_s=0.2):
+    return {"opcost": {
+        "per_class_s": {"compute": compute_s, "collective": collective_s,
+                        "copy": 0.0, "host-transfer": 0.0},
+        "collectives": [{"op": "all-reduce", "s": collective_s}],
+        "total_s": compute_s + collective_s,
+    }}
+
+
+class TestTraceDiff:
+    def test_seeded_slowdown_names_the_class(self):
+        att = trace_diff.attribute_records(_rec(0.2), _rec(0.8))
+        assert att["available"]
+        assert att["dominant_class"] == "collective"
+        row = att["by_class"]["collective"]
+        assert row["delta_s"] == pytest.approx(0.6)
+        assert row["share_of_regression"] == pytest.approx(1.0)
+        assert att["collectives"]["all-reduce"]["delta_s"] == (
+            pytest.approx(0.6)
+        )
+        assert "'collective'" in att["detail"]
+
+    def test_shares_split_across_grown_classes(self):
+        old, new = _rec(0.2), _rec(0.5, compute_s=0.5)
+        att = trace_diff.attribute_records(old, new)
+        by = att["by_class"]
+        assert by["collective"]["share_of_regression"] == pytest.approx(0.5)
+        assert by["compute"]["share_of_regression"] == pytest.approx(0.5)
+        # a class that did not grow carries no share
+        assert by["copy"]["share_of_regression"] is None
+
+    def test_raw_op_table_accepted(self):
+        t_old = opcost.op_table(_events())
+        t_new = opcost.op_table(_events() + [
+            _op(2, 7, "all-reduce.9", 10000.0),
+        ])
+        diff = trace_diff.diff_tables(t_old, t_new)
+        assert diff["dominant_class"] == "collective"
+
+    def test_attribution_never_raises(self):
+        att = trace_diff.attribute_records(None, _rec(0.8))
+        assert att == {"available": False, "reason": att["reason"]}
+        assert "opcost" in att["reason"]
+        assert not trace_diff.attribute_records({}, {})["available"]
+        assert not trace_diff.attribute_records(
+            {"opcost": "garbage"}, _rec(0.1)
+        )["available"]
+
+
+# -- graftcheck runtime rules -------------------------------------------
+
+
+class TestRuntimeRules:
+    def _run(self):
+        return run_rules(
+            AnalysisContext(), planes=("runtime",), ignore=frozenset()
+        )
+
+    def test_comm_bandwidth_degraded_fires(self):
+        opcost.runtime_stats["axis_bandwidth"] = {"dp": 1.0e9}
+        opcost.runtime_stats["axis_bandwidth_best"] = {"dp": 4.0e9}
+        hits = self._run().by_rule("comm-bandwidth-degraded")
+        assert len(hits) == 1 and hits[0].severity is Severity.WARN
+        assert "'dp'" in hits[0].message
+
+    def test_comm_bandwidth_quiet_when_healthy(self):
+        opcost.runtime_stats["axis_bandwidth"] = {"dp": 3.0e9}
+        opcost.runtime_stats["axis_bandwidth_best"] = {"dp": 4.0e9}
+        assert not self._run().by_rule("comm-bandwidth-degraded")
+
+    def test_comm_bandwidth_threshold_env(self, monkeypatch):
+        monkeypatch.setenv("GRAFT_BW_DEGRADED_FRAC", "0.9")
+        opcost.runtime_stats["axis_bandwidth"] = {"dp": 3.0e9}
+        opcost.runtime_stats["axis_bandwidth_best"] = {"dp": 4.0e9}
+        assert self._run().by_rule("comm-bandwidth-degraded")
+
+    def test_calibration_drift_fires(self):
+        opcost.runtime_stats["calibration"] = {
+            "wire": {"ratio": 3.0, "drift": 0.9, "analytic": 100.0,
+                     "measured": 300.0, "unit": "bytes"},
+        }
+        hits = self._run().by_rule("calibration-drift")
+        assert len(hits) == 1 and hits[0].severity is Severity.ERROR
+        assert "'wire'" in hits[0].message
+
+    def test_calibration_drift_quiet_inside_tolerance(self):
+        opcost.runtime_stats["calibration"] = {
+            "wire": {"ratio": 2.0, "drift": 0.2},
+            "first-sight": {"ratio": 1.0, "drift": None},
+        }
+        assert not self._run().by_rule("calibration-drift")
+
+
+# -- profiler re-entrancy guard (satellite) -----------------------------
+
+
+class TestProfilerGuard:
+    def test_second_entrant_noop_with_warning(self, monkeypatch):
+        monkeypatch.setitem(profiling._ACTIVE, "logdir", "/tmp/owner")
+        with pytest.warns(RuntimeWarning, match="already active"):
+            assert profiling.start_profiler_trace("/tmp/second") is False
+        assert profiling.profiler_active() == "/tmp/owner"
+
+    def test_trace_cm_does_not_stop_the_owner(self, monkeypatch):
+        monkeypatch.setitem(profiling._ACTIVE, "logdir", "/tmp/owner")
+        with pytest.warns(RuntimeWarning):
+            with profiling.trace("/tmp/second"):
+                pass
+        # the no-op entrant must not stop the owner's trace
+        assert profiling.profiler_active() == "/tmp/owner"
+
+    def test_stop_without_ownership_is_noop(self):
+        assert profiling.profiler_active() is None
+        profiling.stop_profiler_trace()  # must not raise
+
+
+# -- HBM budget fallback (satellite) ------------------------------------
+
+
+class _NoStats:
+    def memory_stats(self):
+        return None
+
+
+class _WithStats:
+    def memory_stats(self):
+        return {"bytes_limit": 1 << 30, "peak_bytes_in_use": 1 << 20,
+                "bytes_in_use": 1 << 10}
+
+
+@pytest.fixture
+def clean_memory_stats():
+    saved = dict(mem.runtime_stats)
+    yield
+    mem.runtime_stats.clear()
+    mem.runtime_stats.update(saved)
+
+
+class TestHbmBudget:
+    def test_host_fallback_is_the_default(self, clean_memory_stats):
+        host = mem.host_memory_budget()
+        assert host is not None and host > 0  # linux sysconf
+        assert mem.device_hbm_budget(_NoStats()) == host
+        assert mem.runtime_stats["budget_source"] == "host-fallback"
+
+    def test_fallback_none_restores_strict(self, clean_memory_stats):
+        assert mem.device_hbm_budget(_NoStats(), fallback=None) is None
+        assert mem.runtime_stats["budget_source"] is None
+
+    def test_explicit_fallback_value(self, clean_memory_stats):
+        assert mem.device_hbm_budget(_NoStats(), fallback=123) == 123
+
+    def test_device_stats_win(self, clean_memory_stats):
+        assert mem.device_hbm_budget(_WithStats()) == 1 << 30
+        assert mem.runtime_stats["budget_source"] == "device"
+
+    def test_record_hbm_stats(self, clean_memory_stats):
+        got = mem.record_hbm_stats(_WithStats(), projected_peak_bytes=777)
+        assert got["hbm_high_water_bytes"] == 1 << 20
+        assert got["hbm_in_use_bytes"] == 1 << 10
+        assert got["projected_peak_bytes"] == 777
+
+
+# -- analytic comm cost (TrainStep.comm_cost) ---------------------------
+
+
+def _loss(params, batch, rng, model_state):
+    return jnp.mean(params["w"]) * 0.0, {}
+
+
+class TestCommCost:
+    def test_ddp_all_reduce_two_hops(self, mesh8):
+        step = TrainStep(_loss, optax.sgd(1e-3), mesh8, DDP())
+        params = {"w": jnp.zeros((4096,)), "b": jnp.zeros((8,))}
+        got = step.comm_cost(params)
+        assert got["collective"] == "all-reduce"
+        assert got["axis"] == "dp" and got["axis_size"] == 8
+        assert got["fp32_bytes"] == (4096 + 8) * 4 * 2
+
+    def test_zero2_reduce_scatter_floor(self, zero_mesh8):
+        step = TrainStep(_loss, optax.sgd(1e-3), zero_mesh8, ZeRO2())
+        params = {"w": jnp.zeros((4096,)), "b": jnp.zeros((8,))}
+        got = step.comm_cost(params)
+        assert got["collective"] == "reduce-scatter"
+        # w shards (1 hop); b is below min_shard_size -> all-reduce rate
+        assert got["fp32_bytes"] == 4096 * 4 + 8 * 4 * 2
+
+    def test_single_device_is_free(self, devices8):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        mesh1 = Mesh(np.array(devices8[:1]), ("dp",))
+        step = TrainStep(_loss, optax.sgd(1e-3), mesh1, DDP())
+        got = step.comm_cost({"w": jnp.zeros((64,))})
+        assert got["fp32_bytes"] == 0 and got["collective"] is None
+
+
+# -- facade env twins ---------------------------------------------------
+
+
+class TestEnvTwins:
+    def test_opcost_env_twin(self, monkeypatch):
+        from pytorch_distributedtraining_tpu.stoke.facade import (
+            _opcost_from_env,
+        )
+
+        cfg = SimpleNamespace(opcost=False)
+        monkeypatch.delenv("GRAFT_OPCOST", raising=False)
+        assert _opcost_from_env(cfg) is False
+        assert _opcost_from_env(SimpleNamespace(opcost=True)) is True
+        monkeypatch.setenv("GRAFT_OPCOST", "1")
+        assert _opcost_from_env(cfg) is True
+        monkeypatch.setenv("GRAFT_OPCOST", "off")
+        assert _opcost_from_env(SimpleNamespace(opcost=True)) is False
+
+    def test_capture_env_twin(self, monkeypatch):
+        from pytorch_distributedtraining_tpu.stoke.facade import (
+            _capture_from_env,
+        )
+
+        cfg = SimpleNamespace(capture=False, capture_dir=None)
+        monkeypatch.delenv("GRAFT_CAPTURE", raising=False)
+        assert _capture_from_env(cfg) == (False, None)
+        monkeypatch.setenv("GRAFT_CAPTURE", "1")
+        assert _capture_from_env(cfg) == (True, None)
+        monkeypatch.setenv("GRAFT_CAPTURE", "/cap/dir")
+        assert _capture_from_env(cfg) == (True, "/cap/dir")
+        monkeypatch.setenv("GRAFT_CAPTURE", "0")
+        assert _capture_from_env(
+            SimpleNamespace(capture=True, capture_dir="/cfg")
+        ) == (False, "/cfg")
+
+
+# -- package surface ----------------------------------------------------
+
+
+def test_observe_package_reexports():
+    from pytorch_distributedtraining_tpu import observe
+
+    assert observe.OnDemandProfiler is cap.OnDemandProfiler
+    assert observe.calibrate is opcost.calibrate
+    assert observe.load_trace_events is opcost.load_trace_events
+    assert observe.op_table is opcost.op_table
+    assert observe.collective_bandwidth is opcost.collective_bandwidth
+    assert observe.device_hbm_budget is mem.device_hbm_budget
