@@ -344,6 +344,7 @@ _ARM_ENVS = (  # envs that change WHICH arm is being measured
     "GRAFT_BENCH_SCAN_K", "GRAFT_BENCH_FEED", "GRAFT_BENCH_PREFETCH",
     "GRAFT_REMAT", "GRAFT_SCAN_LAYERS", "GRAFT_WIRE", "GRAFT_FP8",
     "GRAFT_BENCH_RECOVERY", "GRAFT_BENCH_SERVE",
+    "GRAFT_BENCH_SERVE_FLEET",
 )
 
 
@@ -801,6 +802,111 @@ def _serve_arm() -> None:
     _emit_error("serve arm: no serve_slo record in child output")
 
 
+def _serve_fleet_arm() -> None:
+    """Fleet-failover arm (GRAFT_BENCH_SERVE_FLEET=1): the router's
+    never-hang record.
+
+    Runs the serve-failover chaos drill (``runtime/recovery_drill.py``
+    with ``GRAFT_DRILL_MODE=serve_failover``): three replica
+    subprocesses behind a TCP membership store, an open-loop Poisson
+    trace through the fleet router, one SIGKILL mid-decode and one
+    graceful drain. The record carries ``time_to_failover_s`` (headline),
+    the terminal-state census (migrated / replayed / shed), p99 latency
+    during the failover window, and ``router_overhead_fraction`` — the
+    router's own bookkeeping cost, priced under the same 1% gate as the
+    telemetry plane (over it, the record is withheld as an error).
+    """
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="graft-serve-fleet-")
+    out = os.path.join(workdir, "events.jsonl")
+    env = dict(os.environ)
+    env.update(
+        GRAFT_DRILL_MODE="serve_failover",
+        GRAFT_DRILL_OUT=out,
+        GRAFT_DRILL_CKPT=os.path.join(workdir, "scratch"),
+        JAX_PLATFORMS=env.get("JAX_PLATFORMS", "cpu"),
+        PYTHONUNBUFFERED="1",
+    )
+    _status(
+        "serve fleet arm: 3-replica failover drill (SIGKILL + drain)"
+    )
+    cmd = [
+        sys.executable, "-m",
+        "pytorch_distributedtraining_tpu.runtime.recovery_drill",
+    ]
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            cmd, env=env, capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        _emit_error("serve fleet arm: failover drill hung >600s")
+        return
+    wall_s = time.monotonic() - t0
+    events = []
+    try:
+        with open(out) as fh:
+            events = [json.loads(l) for l in fh if l.strip()]
+    except (OSError, ValueError):
+        events = []
+    skip = next((e for e in events if e["event"] == "skip"), None)
+    if skip is not None:
+        _emit_result(json.dumps({
+            "metric": "serve_fleet_failover",
+            "skipped": True,
+            "unit": "s",
+            "reason": skip.get("reason", ""),
+        }))
+        return
+    trace = next(
+        (e for e in events if e["event"] == "trace_done"), None
+    )
+    if proc.returncode != 0 or trace is None:
+        tail = (proc.stderr or proc.stdout or "")[-500:]
+        _emit_error(
+            f"serve fleet arm: drill rc={proc.returncode}, "
+            f"{len(events)} events: {tail}"
+        )
+        return
+    overhead = trace.get("router_overhead_fraction")
+    if overhead is not None and overhead > 0.01:
+        # same philosophy as the telemetry gate: a router that costs more
+        # than 1% of the serving wall is itself the regression
+        _emit_error(
+            f"serve fleet arm: router overhead {overhead:.2%} over the "
+            "1% gate — record withheld"
+        )
+        return
+    record = {
+        "metric": "serve_fleet_failover",
+        "value": round(trace.get("time_to_failover_s") or 0.0, 3),
+        "unit": "s",
+        "time_to_failover_s": round(
+            trace.get("time_to_failover_s") or 0.0, 3
+        ),
+        "requests": trace.get("requests"),
+        "outcomes": trace.get("outcomes"),
+        "requests_migrated": trace.get("requests_migrated"),
+        "requests_replayed": trace.get("requests_replayed"),
+        "requests_shed": trace.get("requests_shed"),
+        "failovers": trace.get("failovers"),
+        "lifecycles_closed": trace.get("lifecycles_closed"),
+        "over_deadline": trace.get("over_deadline"),
+        "p50_latency_s": round(trace.get("p50_latency_s") or 0.0, 4),
+        "p99_latency_s": round(trace.get("p99_latency_s") or 0.0, 4),
+        "p99_latency_during_failover_s": round(
+            trace.get("p99_latency_during_failover_s") or 0.0, 4
+        ),
+        "router_overhead_fraction": round(overhead or 0.0, 5),
+        "survivor_pages_in_use": trace.get("survivor_pages_in_use"),
+        "drill_wall_s": round(trace.get("wall_s") or 0.0, 3),
+        "arm_wall_s": round(wall_s, 3),
+    }
+    _emit_result(json.dumps(record))
+
+
 def _extract_json_line(lines: list[str]) -> str | None:
     """Last line that parses as the result record, if any."""
     for line in reversed(lines):
@@ -829,6 +935,11 @@ def main() -> None:
         # the recovery arm is pool-free (CPU drill through the elastic
         # launcher) — no probe loop, no TPU claim, its own 900s bound
         _recovery_arm()
+        return
+    if os.environ.get("GRAFT_BENCH_SERVE_FLEET"):
+        # pool-free like the recovery arm: replica subprocesses on the
+        # CPU backend, the router's never-hang contract under chaos
+        _serve_fleet_arm()
         return
     if os.environ.get("GRAFT_BENCH_SERVE"):
         # the serving arm defaults to the pool-free CPU self-test; its

@@ -48,6 +48,10 @@ STAGES = [
              "grow back to 2 with a bitwise reshard check — "
              "time_to_grow_s (bench.py, GRAFT_BENCH_RECOVERY=1 "
              "GRAFT_BENCH_RECOVERY_GROW=1)"),
+    ("serve_fleet", "serve-fleet failover drill: time_to_failover_s, "
+                    "terminal-state census (migrated/replayed/shed) and "
+                    "router overhead under SIGKILL + graceful drain "
+                    "(bench.py, GRAFT_BENCH_SERVE_FLEET=1)"),
     ("fleet", "fleet observability: merged cross-host trace rollup "
               "(trace_summary.py per-host lanes) + perf-regression "
               "sentry vs the BENCH_* trajectory (regress.py)"),
@@ -122,6 +126,8 @@ ARM_KNOBS = {
     "grow": "GRAFT_BENCH_RECOVERY=1 GRAFT_BENCH_RECOVERY_GROW=1",
     # serving SLO arm (summary record; continuous-vs-static lives inside)
     "serve": "GRAFT_BENCH_SERVE=1",
+    # fleet failover arm (robustness record, never a throughput winner)
+    "serve_fleet": "GRAFT_BENCH_SERVE_FLEET=1",
     # numerics plane arm (health record, never a throughput winner)
     "numerics": "GRAFT_NUMERICS=1 GRAFT_NUMERICS_ACTION=halt",
     # op-cost attribution arm (attribution record, never a winner)

@@ -251,6 +251,111 @@ def serve_slo_burn(ctx):
         )
 
 
+@rule(
+    "router-hang",
+    "runtime",
+    "a routed request is still open past the fleet router's deadline",
+)
+def router_hang(ctx):
+    # sys.modules, never imported: serve.router is stdlib-only but its
+    # package __init__ pulls jax — a live router populates runtime_stats
+    rt = sys.modules.get("pytorch_distributedtraining_tpu.serve.router")
+    stats = getattr(rt, "runtime_stats", None)
+    if not stats:
+        return
+    deadline = stats.get("deadline_s")
+    inflight = stats.get("inflight") or {}
+    if deadline is None or not inflight:
+        return
+    import time as _time
+
+    now = _time.monotonic()
+    stuck = sorted(
+        (rid, now - t0) for rid, t0 in inflight.items()
+        if now - t0 > float(deadline)
+    )
+    if not stuck:
+        return
+    worst_rid, worst_age = max(stuck, key=lambda kv: kv[1])
+    yield Finding(
+        "router-hang",
+        Severity.ERROR,
+        "runtime:serve",
+        f"{len(stuck)} routed request(s) are still open PAST the "
+        f"{float(deadline):.0f}s dispatch deadline with no terminal "
+        f"phase in the ledger (worst: rid={worst_rid} open "
+        f"{worst_age:.1f}s): the router's never-hang contract is broken "
+        "— a dispatch is blocked on a replica that neither answered nor "
+        "died visibly. Check the replica's heartbeat (TTL expiry should "
+        "have failed it over) and the transport's timeout wiring",
+        evidence=(
+            f"deadline_s={deadline} stuck={len(stuck)} "
+            f"worst_rid={worst_rid} worst_age_s={worst_age:.3f} "
+            f"inflight={len(inflight)}"
+        ),
+    )
+
+
+@rule(
+    "serve-replica-flap",
+    "runtime",
+    "a serve replica cycling register/deregister inside one hysteresis "
+    "window",
+)
+def serve_replica_flap(ctx):
+    # same elastic-flap machinery, applied per replica: membership's
+    # runtime_stats records every replica register/deregister with a
+    # monotonic stamp
+    ms = sys.modules.get(
+        "pytorch_distributedtraining_tpu.runtime.membership"
+    )
+    stats = getattr(ms, "runtime_stats", None)
+    if not stats:
+        return
+    events = stats.get("replica_events") or []
+    if not events:
+        return
+    window = max(float(stats.get("hysteresis_window_s") or 30.0), 1.0)
+    try:
+        limit = int(os.environ.get("GRAFT_FLAP_MAX", "3") or 3)
+    except ValueError:
+        limit = 3
+    per_replica: dict = {}
+    for t, rid, kind in events:
+        per_replica.setdefault(str(rid), []).append(float(t))
+    for rid, times in sorted(per_replica.items()):
+        times.sort()
+        # a register/deregister PAIR is one cycle; count lifecycle
+        # events in the worst sliding window and halve
+        worst = 0
+        lo = 0
+        for hi in range(len(times)):
+            while times[hi] - times[lo] > window:
+                lo += 1
+            worst = max(worst, hi - lo + 1)
+        cycles = worst // 2
+        if cycles <= limit:
+            continue
+        yield Finding(
+            "serve-replica-flap",
+            Severity.WARN,
+            "runtime:serve",
+            f"replica {rid!r} cycled register/deregister {cycles} times "
+            f"inside one {window:.0f}s hysteresis window (flap limit "
+            f"{limit}): the fleet is churning a replica faster than the "
+            "scale gate can damp — every cycle re-warms an engine and "
+            "migrates or replays its residents. Raise GRAFT_FLAP_MAX "
+            "only if the churn is intentional; otherwise widen the "
+            "GrowGate (GRAFT_GROW_PROBES / GRAFT_GROW_MIN_INTERVAL_S) "
+            "or fix the replica's crash loop",
+            evidence=(
+                f"replica={rid} events={len(times)} worst_window={worst} "
+                f"cycles={cycles} window_s={window:.0f} "
+                f"flap_limit={limit}"
+            ),
+        )
+
+
 def _numerics_stats():
     """observe.numerics.runtime_stats via sys.modules — never imported
     (stdlib-only module, but importing it here would defeat the

@@ -6,80 +6,112 @@ plus the tracing the reference lacks (SURVEY §5) — `jax.profiler` hooks and
 per-step timing.
 """
 
-from . import wandb_compat as wandb
-from .hlo import (
-    WIRE_NARROW_DTYPES,
-    CollectiveOp,
-    HloInstruction,
-    OverlapAudit,
-    OverlapFinding,
-    PipelineAudit,
-    WireCollective,
-    collective_inventory,
-    collectives_schedulable,
-    counts,
-    has_logical_reduce_scatter,
-    max_all_reduce_elems,
-    overlap_audit,
-    pipeline_audit,
-    tokenize_hlo,
-    wire_inventory,
-)
-from .memory import (
-    MemoryStats,
-    compiled_memory_stats,
-    device_hbm_budget,
-    host_memory_budget,
-    record_hbm_stats,
-    tune_batch_size,
-)
-from . import opcost  # op-cost attribution plane (stdlib-only)
-from .opcost import (
-    calibrate,
-    collective_bandwidth,
-    load_trace_events,
-    op_table,
-)
-from .capture import OnDemandProfiler
-from . import trace  # the span-telemetry module (observe.trace)
-from .goodput import (
-    GoodputLedger,
-    StepLog,
-    StragglerReport,
-    flag_stragglers,
-    mfu,
-    model_train_flops,
-    peak_flops,
-    read_step_logs,
-    straggler_check,
-)
-from . import fleet  # the fleet aggregation plane (observe.fleet)
-from .fleet import (
-    ClockOffset,
-    FleetMonitor,
-    MetricsExporter,
-    RankMetricsPublisher,
-    StreamHist,
-    estimate_offset,
-    estimate_store_offset,
-    lane_ledgers,
-    load_trajectory,
-    merge_ledgers,
-    merge_traces,
-    per_host_mfu,
-    regression_verdict,
-)
-from .sink import JSONLSink, MetricsSink, NullSink, WandbSink, make_sink
-from .profiling import StepTimer, TransferOverlapProbe
-from .profiling import trace as profiler_trace
-from .trace import (
-    Tracer,
-    export_chrome_trace,
-    flush_flight_record,
-    instant,
-    span,
-    traced,
-)
+# PEP 562 lazy exports: the serve fleet's control plane (serve/router.py,
+# serve/fleet.py — replica processes under GRAFT_FLEET_FAKE=1) and the other
+# jax-free tooling import the stdlib-only submodules here (slo, goodput,
+# fleet, opcost, hlo); an eager `from .memory import ...` would drag jax into
+# every one of them. Name -> (submodule, attr): submodule None = the submodule
+# named `name` itself; attr "*" = the submodule object under an alias; attr
+# None = the attribute named `name`.
+_LAZY = {
+    "wandb": ("wandb_compat", "*"),
+    "hlo": (None, None),
+    "WIRE_NARROW_DTYPES": ("hlo", None),
+    "CollectiveOp": ("hlo", None),
+    "HloInstruction": ("hlo", None),
+    "OverlapAudit": ("hlo", None),
+    "OverlapFinding": ("hlo", None),
+    "PipelineAudit": ("hlo", None),
+    "WireCollective": ("hlo", None),
+    "collective_inventory": ("hlo", None),
+    "collectives_schedulable": ("hlo", None),
+    "counts": ("hlo", None),
+    "has_logical_reduce_scatter": ("hlo", None),
+    "max_all_reduce_elems": ("hlo", None),
+    "overlap_audit": ("hlo", None),
+    "pipeline_audit": ("hlo", None),
+    "tokenize_hlo": ("hlo", None),
+    "wire_inventory": ("hlo", None),
+    "memory": (None, None),
+    "MemoryStats": ("memory", None),
+    "compiled_memory_stats": ("memory", None),
+    "device_hbm_budget": ("memory", None),
+    "host_memory_budget": ("memory", None),
+    "record_hbm_stats": ("memory", None),
+    "tune_batch_size": ("memory", None),
+    "opcost": (None, None),
+    "calibrate": ("opcost", None),
+    "collective_bandwidth": ("opcost", None),
+    "load_trace_events": ("opcost", None),
+    "op_table": ("opcost", None),
+    "capture": (None, None),
+    "OnDemandProfiler": ("capture", None),
+    "trace": (None, None),
+    "goodput": (None, None),
+    "GoodputLedger": ("goodput", None),
+    "StepLog": ("goodput", None),
+    "StragglerReport": ("goodput", None),
+    "flag_stragglers": ("goodput", None),
+    "mfu": ("goodput", None),
+    "model_train_flops": ("goodput", None),
+    "peak_flops": ("goodput", None),
+    "read_step_logs": ("goodput", None),
+    "straggler_check": ("goodput", None),
+    "fleet": (None, None),
+    "ClockOffset": ("fleet", None),
+    "FleetMonitor": ("fleet", None),
+    "MetricsExporter": ("fleet", None),
+    "RankMetricsPublisher": ("fleet", None),
+    "StreamHist": ("fleet", None),
+    "estimate_offset": ("fleet", None),
+    "estimate_store_offset": ("fleet", None),
+    "lane_ledgers": ("fleet", None),
+    "load_trajectory": ("fleet", None),
+    "merge_ledgers": ("fleet", None),
+    "merge_traces": ("fleet", None),
+    "per_host_mfu": ("fleet", None),
+    "regression_verdict": ("fleet", None),
+    "slo": (None, None),
+    "numerics": (None, None),
+    "sink": (None, None),
+    "JSONLSink": ("sink", None),
+    "MetricsSink": ("sink", None),
+    "NullSink": ("sink", None),
+    "WandbSink": ("sink", None),
+    "make_sink": ("sink", None),
+    "profiling": (None, None),
+    "StepTimer": ("profiling", None),
+    "TransferOverlapProbe": ("profiling", None),
+    "profiler_trace": ("profiling", "trace"),
+    "Tracer": ("trace", None),
+    "export_chrome_trace": ("trace", None),
+    "flush_flight_record": ("trace", None),
+    "instant": ("trace", None),
+    "span": ("trace", None),
+    "traced": ("trace", None),
+}
+
+
+def __getattr__(name):
+    try:
+        submodule, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    from importlib import import_module
+
+    if submodule is None:
+        return import_module(f".{name}", __name__)
+    mod = import_module(f".{submodule}", __name__)
+    if attr == "*":
+        return mod
+    return getattr(mod, attr or name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))
+
 
 __all__ = [
     "wandb",

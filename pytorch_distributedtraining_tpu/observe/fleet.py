@@ -636,6 +636,23 @@ def _numerics_rolling_gauges() -> dict:
     return out
 
 
+def _router_rolling_gauges() -> dict:
+    """The serve-fleet router's per-dispatch counters (in-flight depth,
+    delivered/failover/replay/shed totals — serve/router.py) —
+    sys.modules, never imported, so a rank that never hosted a router
+    publishes nothing. The failover instants themselves land in the
+    trace stream (``fleet.failover``); these gauges are the Prometheus
+    view the monitor labels per rank."""
+    out: dict = {}
+    rt = sys.modules.get(
+        "pytorch_distributedtraining_tpu.serve.router"
+    )
+    for name, v in (getattr(rt, "rolling_gauges", None) or {}).items():
+        if isinstance(v, (int, float)):
+            out[str(name)] = float(v)
+    return out
+
+
 class RankMetricsPublisher:
     """One rank's metric publication into the membership store.
 
@@ -694,6 +711,7 @@ class RankMetricsPublisher:
         gauges = _serve_rolling_gauges()
         gauges.update(_numerics_rolling_gauges())
         gauges.update(_opcost_rolling_gauges())
+        gauges.update(_router_rolling_gauges())
         if gauges:
             doc["gauges"] = gauges
         if self.offset is not None:

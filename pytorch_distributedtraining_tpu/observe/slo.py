@@ -48,11 +48,16 @@ PHASES = (
     "stall",       # slow-reader/client time at delivery
     "deliver",     # record assembly + handoff
     "shed",        # terminal marker: dropped at admission
+    "dispatch",    # router: request in flight to a replica; attrs:
+                   # replica, attempt, error (on a failed dispatch)
+    "migrate",     # router/fleet: decode state moved between replicas
 )
 OTHER = "other"
 
-# outcomes a lifecycle can close with
+# outcomes a lifecycle can close with; MIGRATED closes the *source*
+# lifecycle when a drain hands resident decode state to another replica
 DONE, SHED, CANCELLED = "done", "shed", "cancelled"
+MIGRATED = "migrated"
 
 # phases whose intervals may carry a padding_fraction (bucket/batch waste)
 _COMPUTE_PHASES = ("prefill", "decode", "tile")
@@ -502,6 +507,8 @@ _PHASE_CAT = {
     "stall": "outage",
     "deliver": "other",
     "shed": "fault",
+    "dispatch": "membership",
+    "migrate": "checkpoint",
 }
 
 

@@ -46,6 +46,17 @@ Named sites (each threaded into the layer that owns it):
                        reader stalling the tick loop, ``raise`` a
                        disconnect cancelling the request
                        (``serve/engine.py``, ``serve/tiles.py``)
+``route.dispatch``     router is about to pick a replica for a dispatch
+                       attempt — ``raise`` skips the attempt (burns retry
+                       budget), ``sleep`` delays it (``serve/router.py``)
+``replica.kill``       serve replica dies mid-decode — ``kill`` is the
+                       chaos drill's SIGKILL-equivalent; the router must
+                       fail over every resident request
+                       (``serve/fleet.py``)
+``replica.drain``      serve replica is about to migrate its resident
+                       decode state out — ``raise`` forces the replay
+                       path instead of the migrate path
+                       (``serve/fleet.py``)
 =====================  =====================================================
 
 A plan is JSON — inline in ``GRAFT_FAULT_PLAN`` or a file path — so it
@@ -105,6 +116,9 @@ SITES = frozenset({
     "bench.child",
     "serve.admit",
     "serve.client",
+    "route.dispatch",
+    "replica.kill",
+    "replica.drain",
 })
 
 

@@ -6,26 +6,59 @@ groups and env:// TCPStore rendezvous (`Fairscale-DDP.py:27,122-123`;
 coordination service) and `jax.sharding.Mesh` over ICI/DCN axes.
 """
 
-from .dist import (
-    initialize,
-    shutdown,
-    is_initialized,
-    rank,
-    world_size,
-    process_index,
-    process_count,
-    local_device_count,
-    device_count,
-    find_free_port,
-    force_platform,
-    force_platform_from_env,
-    enable_latency_hiding_scheduler,
-)
-from .mesh import (
-    MeshSpec, make_mesh, make_hybrid_mesh, best_mesh, mesh_axis_size,
-    current_mesh,
-)
-from .cache import cache_dir, enable_compile_cache, cache_entry_count
+# PEP 562 lazy exports: `runtime.membership` and `runtime.launch` are
+# stdlib-only (the elastic launcher and the serve fleet's replica processes
+# import them jax-free); an eager `from .dist import ...` here would drag
+# jax into both. Name -> source submodule; None = the submodule itself.
+_LAZY = {
+    "dist": None,
+    "mesh": None,
+    "cache": None,
+    "launch": None,
+    "membership": None,
+    "recovery_drill": None,
+    "initialize": "dist",
+    "shutdown": "dist",
+    "is_initialized": "dist",
+    "rank": "dist",
+    "world_size": "dist",
+    "process_index": "dist",
+    "process_count": "dist",
+    "local_device_count": "dist",
+    "device_count": "dist",
+    "find_free_port": "dist",
+    "force_platform": "dist",
+    "force_platform_from_env": "dist",
+    "enable_latency_hiding_scheduler": "dist",
+    "MeshSpec": "mesh",
+    "make_mesh": "mesh",
+    "make_hybrid_mesh": "mesh",
+    "best_mesh": "mesh",
+    "mesh_axis_size": "mesh",
+    "current_mesh": "mesh",
+    "cache_dir": "cache",
+    "enable_compile_cache": "cache",
+    "cache_entry_count": "cache",
+}
+
+
+def __getattr__(name):
+    try:
+        submodule = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    from importlib import import_module
+
+    if submodule is None:
+        return import_module(f".{name}", __name__)
+    return getattr(import_module(f".{submodule}", __name__), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))
+
 
 __all__ = [
     "initialize",

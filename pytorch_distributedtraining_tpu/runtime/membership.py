@@ -45,6 +45,10 @@ On-disk layout (documented in docs/RESILIENCE.md)::
                              "quarantine_round", "quarantined_until"}
       ranks/<rank>.json     rank-level liveness (runtime/dist.initialize)
       results/<epoch>_<host>.json   per-host generation outcome
+      replicas/<id>.json    serve-replica role record (serve/router.py):
+                            {"replica_id", "host_id", "address",
+                             "standby", "draining", "registered_t",
+                             "last_heartbeat"}
       transitions.jsonl     append-only membership transition log
 """
 
@@ -75,13 +79,16 @@ runtime_stats: dict = {
     "hysteresis_window_s": None,  # the launcher's min-interval knob
     "flap_limit": None,           # max epoch advances tolerated per window
     "transitions": 0,
+    # serve-replica lifecycle events for the ``serve-replica-flap`` rule:
+    # (time.monotonic(), replica_id, "register"|"deregister") tuples
+    "replica_events": [],
 }
 
 
 def reset_runtime_stats() -> None:
     runtime_stats.update(
         epoch_advances=[], hysteresis_window_s=None, flap_limit=None,
-        transitions=0,
+        transitions=0, replica_events=[],
     )
 
 
@@ -160,7 +167,9 @@ class MembershipStore:
             else os.environ.get("GRAFT_QUARANTINE_MAX_S", "3600")
         )
         self._clock = clock
-        for sub in ("hosts", "health", "ranks", "results", "metrics"):
+        for sub in (
+            "hosts", "health", "ranks", "results", "metrics", "replicas",
+        ):
             os.makedirs(os.path.join(self.root, sub), exist_ok=True)
 
     # -- paths -------------------------------------------------------------
@@ -545,6 +554,129 @@ class MembershipStore:
             out.append(doc)
         return out
 
+    # -- serve-replica role records (serve/router.py, serve/fleet.py) --------
+
+    def _replica_path(self, replica_id: str) -> str:
+        return os.path.join(
+            self.root, "replicas", f"{_check_host_id(replica_id)}.json"
+        )
+
+    def register_replica(
+        self,
+        replica_id: str,
+        host_id: str = "",
+        address: str = "",
+        standby: bool = False,
+    ) -> dict:
+        """Announce a serve replica (an engine process the router may
+        dispatch to). ``address`` is the replica's transport endpoint
+        (``tcp://host:port``; empty for in-process fleets); ``standby``
+        marks registered-but-not-serving capacity the scale controller
+        can admit on sustained SLO burn. Idempotent — re-registration
+        refreshes the heartbeat and clears any drain mark."""
+        now = self._clock()
+        prev = _read_json(self._replica_path(replica_id))
+        doc = {
+            "replica_id": _check_host_id(replica_id),
+            "host_id": str(host_id),
+            "address": str(address),
+            "standby": bool(standby),
+            "draining": False,
+            "registered_t": (prev or {}).get("registered_t", now),
+            "last_heartbeat": now,
+        }
+        _write_json_atomic(self._replica_path(replica_id), doc)
+        if prev is None or prev.get("draining"):
+            runtime_stats["replica_events"].append(
+                (time.monotonic(), str(replica_id), "register")
+            )
+            self.record_transition(
+                "replica_register", replica=replica_id, host=host_id,
+                address=address, standby=bool(standby),
+            )
+        return doc
+
+    def replica_heartbeat(self, replica_id: str) -> float:
+        """Refresh a replica's liveness stamp; returns the stamp written.
+        A replica whose heartbeat ages out of the TTL stops being routed
+        to — membership TTL expiry IS the router's loss detector."""
+        path = self._replica_path(replica_id)
+        doc = _read_json(path)
+        if doc is None:
+            raise KeyError(
+                f"heartbeat for unregistered replica {replica_id!r}"
+            )
+        doc["last_heartbeat"] = self._clock()
+        _write_json_atomic(path, doc)
+        return doc["last_heartbeat"]
+
+    def replicas(
+        self,
+        alive_within_s: float | None = None,
+        include_standby: bool = False,
+    ) -> list[dict]:
+        """Registered replicas with live heartbeats, sorted by id.
+        Standby records are excluded unless asked for — the router routes
+        only to serving replicas; the scale controller asks for both."""
+        ttl = self.ttl_s if alive_within_s is None else float(alive_within_s)
+        now = self._clock()
+        out = []
+        rep_dir = os.path.join(self.root, "replicas")
+        try:
+            names = sorted(os.listdir(rep_dir))
+        except OSError:
+            return []
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            doc = _read_json(os.path.join(rep_dir, name))
+            if doc is None:
+                continue
+            if ttl > 0 and now - doc.get("last_heartbeat", 0.0) > ttl:
+                continue
+            if doc.get("standby") and not include_standby:
+                continue
+            out.append(doc)
+        return out
+
+    def request_drain(self, replica_id: str, reason: str = "") -> dict:
+        """Mark a replica for graceful drain: the router stops placing
+        new requests on it immediately (the record's ``draining`` flag);
+        the replica polls :meth:`drain_requested`, finishes or migrates
+        its resident requests, then calls :meth:`deregister_replica`."""
+        path = self._replica_path(replica_id)
+        doc = _read_json(path)
+        if doc is None:
+            raise KeyError(f"drain for unregistered replica {replica_id!r}")
+        if not doc.get("draining"):
+            doc["draining"] = True
+            _write_json_atomic(path, doc)
+            self.record_transition(
+                "replica_drain", replica=replica_id, reason=reason
+            )
+        return doc
+
+    def drain_requested(self, replica_id: str) -> bool:
+        doc = _read_json(self._replica_path(replica_id))
+        return bool(doc and doc.get("draining"))
+
+    def deregister_replica(self, replica_id: str, reason: str = "") -> None:
+        """Remove a replica's role record (graceful exit after drain, or
+        janitorial cleanup of a corpse). Safe to call twice."""
+        path = self._replica_path(replica_id)
+        existed = _read_json(path) is not None
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        if existed:
+            runtime_stats["replica_events"].append(
+                (time.monotonic(), str(replica_id), "deregister")
+            )
+            self.record_transition(
+                "replica_deregister", replica=replica_id, reason=reason
+            )
+
     # -- transitions ---------------------------------------------------------
 
     def record_transition(self, kind: str, **detail) -> None:
@@ -653,6 +785,8 @@ _RPC_METHODS = frozenset({
     "request_teardown", "teardown_requested",
     "record_transition", "transitions",
     "clock_probe", "publish_metrics", "read_metrics",
+    "register_replica", "replica_heartbeat", "replicas",
+    "request_drain", "drain_requested", "deregister_replica",
 })
 
 

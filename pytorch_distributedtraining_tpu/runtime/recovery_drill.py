@@ -44,6 +44,22 @@ Env contract (all inherited through the launcher):
   generation survives until the launcher's grow probes fire.
 - ``GRAFT_FAULT_PLAN``  — the chaos schedule (``ckpt.write`` tear +
   ``train.preempt`` kill), consumed inside the checkpoint layer.
+
+Serve-failover mode (``GRAFT_DRILL_MODE=serve_failover``): instead of a
+train world, the drill stands up a THREE-replica serve fleet as real
+subprocesses behind a TCP membership store, drives an open-loop Poisson
+request trace through a :class:`FleetRouter`, SIGKILLs one replica
+mid-decode and gracefully drains a second — then proves the router's
+never-hang contract: every request reaches a terminal state (delivered /
+migrated / shed) within ``GRAFT_ROUTE_DEADLINE_S``, the request ledger
+closes (``lifecycles_closed``), and the survivors hold zero KV pages
+once idle. Extra knobs: ``GRAFT_DRILL_REQUESTS`` (trace length, default
+32), ``GRAFT_DRILL_RATE_HZ`` (Poisson arrival rate, default 30),
+``GRAFT_DRILL_FAKE`` (1 = stdlib fake engines, the default; 0 = real
+tiny GPT-2 engines), ``GRAFT_DRILL_MAX_NEW`` (tokens per request), plus
+the whole ``GRAFT_ROUTE_*`` family. Images that cannot spawn the
+replica subprocesses (or build their engines) produce a structured
+``skip`` event and exit 0, same as the train drill.
 """
 
 from __future__ import annotations
@@ -293,6 +309,280 @@ def _trainer_main(out: str, ckpt_root: str, done_marker: str) -> int:
     return 0
 
 
+# -- serve-failover mode ----------------------------------------------------
+
+
+def _spawn_replica(
+    scratch: str, store_addr: str, replica_id: str, rank: int, fake: bool,
+):
+    """Launch one replica subprocess and wait for its ``replica_up`` line.
+    Returns ``(proc, info_dict)``; ``info_dict`` is the replica_up event,
+    or an ``error`` event if the replica refused to build its engine."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.update(
+        GRAFT_FLEET_STORE=store_addr,
+        GRAFT_FLEET_REPLICA_ID=replica_id,
+        GRAFT_FLEET_RANK=str(rank),
+        GRAFT_FLEET_FAKE="1" if fake else "",
+        GRAFT_FLEET_DRAIN_DIR=os.path.join(scratch, "migrations"),
+        GRAFT_FLEET_TICK_DELAY_S=os.environ.get(
+            "GRAFT_DRILL_TICK_DELAY_S", "0.05"
+        ),
+        JAX_PLATFORMS=env.get("JAX_PLATFORMS", "cpu"),
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pytorch_distributedtraining_tpu.serve.fleet"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    import threading
+
+    box = {}
+
+    def _read():
+        line = proc.stdout.readline()
+        try:
+            box.update(json.loads(line))
+        except (ValueError, TypeError):
+            box.update(event="error", reason=f"bad replica_up: {line!r}")
+
+    reader = threading.Thread(target=_read, daemon=True)
+    reader.start()
+    # real engines jit-warm a tiny GPT-2 before answering; be generous
+    reader.join(timeout=120.0 if not fake else 30.0)
+    if not box:
+        box.update(event="error", reason="replica_up timeout")
+    return proc, box
+
+
+def _percentile(vals, q: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+def _serve_failover_main(out: str, scratch: str) -> int:
+    """The serve-fleet chaos drill (see module docstring)."""
+    import threading
+
+    t_start = time.monotonic()
+    procs = []
+    store_server = None
+    try:
+        try:
+            from pytorch_distributedtraining_tpu.runtime.membership import (
+                MembershipStore,
+                serve_store,
+            )
+            from pytorch_distributedtraining_tpu.serve.fleet import (
+                tcp_health,
+                tcp_migrate_handler,
+                tcp_transport,
+            )
+            from pytorch_distributedtraining_tpu.serve.router import (
+                FleetRouter,
+                reset_runtime_stats,
+                route_knobs_from_env,
+            )
+            from pytorch_distributedtraining_tpu.serve import (
+                router as _router_mod,
+            )
+        except Exception as e:  # noqa: BLE001 — capability triage
+            if _is_capability_gap(e):
+                _emit(out, event="skip", mode="serve_failover",
+                      reason=f"{type(e).__name__}: {e}"[:300])
+                return 0
+            raise
+
+        # defaults are tuned so the drained replica still HOLDS resident
+        # decode when the drain lands (decode ≫ inter-arrival): the
+        # migrate path is the one worth proving, not the empty drain
+        n_requests = int(os.environ.get("GRAFT_DRILL_REQUESTS", "32"))
+        rate_hz = float(os.environ.get("GRAFT_DRILL_RATE_HZ", "30"))
+        fake = os.environ.get("GRAFT_DRILL_FAKE", "1") != "0"
+        max_new = int(os.environ.get("GRAFT_DRILL_MAX_NEW", "30"))
+        knobs = route_knobs_from_env()
+
+        os.makedirs(os.path.join(scratch, "migrations"), exist_ok=True)
+        store = MembershipStore(
+            os.path.join(scratch, "membership"), ttl_s=10.0
+        )
+        store_server, _ = serve_store(store)
+        host, port = store_server.server_address[:2]
+        store_addr = f"tcp://{host}:{port}"
+        _emit(out, event="serve_fleet_start", store=store_addr,
+              requests=n_requests, rate_hz=rate_hz, fake=fake,
+              deadline_s=knobs["deadline_s"])
+
+        for i in range(3):
+            proc, info = _spawn_replica(
+                scratch, store_addr, f"drill-r{i}", 1000 + i, fake
+            )
+            if info.get("event") != "replica_up":
+                reason = str(info.get("reason", "replica failed to start"))
+                for p, _ in procs:
+                    p.kill()
+                proc.kill()
+                low = reason.lower()
+                if any(s in low for s in _SKIP_SENTINELS) or not fake:
+                    _emit(out, event="skip", mode="serve_failover",
+                          reason=reason[:300])
+                    return 0
+                _emit(out, event="error", mode="serve_failover",
+                      reason=reason[:300])
+                return 1
+            procs.append((proc, info))
+            _emit(out, event="replica_up", replica_id=info["replica_id"],
+                  address=info["address"], pid=info["pid"])
+
+        reset_runtime_stats()
+        router = FleetRouter(store, tcp_transport, **knobs)
+        router.migrate_handler = tcp_migrate_handler(router)
+
+        # wait until the router's joined view shows all three replicas
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if len(router.replicas()) >= 3:
+                break
+            time.sleep(0.05)
+        else:
+            _emit(out, event="error", mode="serve_failover",
+                  reason="router never saw 3 replicas")
+            return 1
+
+        # open-loop Poisson trace: arrivals keep coming whether or not
+        # earlier requests finished — a stalled router visibly backs up
+        import random as _random
+
+        rng = _random.Random(0)
+        results: dict = {}
+        lock = threading.Lock()
+        threads = []
+
+        def _one(rid: int):
+            req = {
+                "rid": rid,
+                "prompt": [1 + (rid % 13), 2 + (rid % 7), 3],
+                "max_new_tokens": max_new,
+            }
+            t0 = time.monotonic()
+            try:
+                resp = router.submit(req)
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                resp = {"outcome": "error",
+                        "error": f"{type(e).__name__}: {e}"}
+            with lock:
+                results[rid] = dict(
+                    resp, latency_s=time.monotonic() - t0,
+                    t_done=time.monotonic(),
+                )
+
+        kill_at = n_requests // 3
+        drain_at = (2 * n_requests) // 3
+        t_kill = None
+        trace_t0 = time.monotonic()
+        for rid in range(n_requests):
+            th = threading.Thread(target=_one, args=(rid,), daemon=True)
+            th.start()
+            threads.append(th)
+            if rid == kill_at:
+                # SIGKILL mid-decode: in-flight dispatches see a TCP
+                # reset, the membership record ages out via TTL
+                procs[0][0].kill()
+                t_kill = time.monotonic()
+                _emit(out, event="replica_killed",
+                      replica_id="drill-r0", after_requests=rid + 1)
+            if rid == drain_at:
+                store.request_drain("drill-r1", reason="drill scale-in")
+                _emit(out, event="drain_requested",
+                      replica_id="drill-r1", after_requests=rid + 1)
+            time.sleep(rng.expovariate(rate_hz))
+
+        join_deadline = time.monotonic() + knobs["deadline_s"] + 15.0
+        for th in threads:
+            th.join(timeout=max(0.0, join_deadline - time.monotonic()))
+        wall_s = time.monotonic() - trace_t0
+
+        hung = [th for th in threads if th.is_alive()]
+        stats = _router_mod.runtime_stats
+        outcomes = {}
+        latencies, failover_lat = [], []
+        over_deadline = 0
+        for rid, res in results.items():
+            oc = res.get("outcome", "error")
+            outcomes[oc] = outcomes.get(oc, 0) + 1
+            latencies.append(res["latency_s"])
+            if res["latency_s"] > knobs["deadline_s"] + 2.0:
+                over_deadline += 1
+            if t_kill is not None and res["t_done"] >= t_kill:
+                failover_lat.append(res["latency_s"])
+
+        # first post-kill delivery that needed a replay = failover proven
+        t_failover = None
+        if t_kill is not None:
+            recovered = sorted(
+                r["t_done"] for r in results.values()
+                if r.get("outcome") == "delivered"
+                and r["t_done"] >= t_kill
+            )
+            if recovered:
+                t_failover = recovered[0] - t_kill
+
+        # survivors must hold zero KV pages once the trace is done
+        survivor_pages = {}
+        for proc, info in procs[1:]:
+            if proc.poll() is not None:
+                continue  # drained replica exits 0 — that's fine
+            try:
+                h = tcp_health(info["address"], timeout_s=5.0)
+                survivor_pages[info["replica_id"]] = h.get(
+                    "pages_in_use", 0
+                )
+            except (OSError, ValueError):
+                survivor_pages[info["replica_id"]] = None
+
+        closed = router.lifecycles_closed()
+        leaked = any(p not in (0, None) for p in survivor_pages.values())
+        ok = (
+            not hung
+            and closed
+            and len(results) == n_requests
+            and over_deadline == 0
+            and not leaked
+        )
+        _emit(
+            out, event="trace_done", ok=ok, mode="serve_failover",
+            requests=n_requests, outcomes=outcomes,
+            hung_threads=len(hung), over_deadline=over_deadline,
+            lifecycles_closed=closed,
+            time_to_failover_s=t_failover,
+            requests_replayed=stats["replayed"],
+            requests_migrated=stats["migrated"],
+            requests_shed=stats["shed"],
+            failovers=stats["failovers"],
+            retries=stats["retries"],
+            p50_latency_s=_percentile(latencies, 0.50),
+            p99_latency_s=_percentile(latencies, 0.99),
+            p99_latency_during_failover_s=_percentile(failover_lat, 0.99),
+            router_overhead_fraction=router.overhead_fraction(wall_s),
+            wall_s=wall_s,
+            survivor_pages_in_use=survivor_pages,
+        )
+        _emit(out, event="serve_failover_done", ok=ok,
+              total_s=time.monotonic() - t_start)
+        return 0 if ok else 1
+    finally:
+        for proc, _ in procs:
+            if proc.poll() is None:
+                proc.kill()
+        if store_server is not None:
+            store_server.shutdown()
+
+
 def main() -> int:
     out = os.environ.get("GRAFT_DRILL_OUT")
     ckpt_root = os.environ.get("GRAFT_DRILL_CKPT")
@@ -302,6 +592,9 @@ def main() -> int:
             file=sys.stderr,
         )
         return 2
+    if os.environ.get("GRAFT_DRILL_MODE") == "serve_failover":
+        os.makedirs(ckpt_root, exist_ok=True)
+        return _serve_failover_main(out, ckpt_root)
     done_marker = os.path.join(ckpt_root, "_DRILL_DONE")
     rank = int(os.environ.get("RANK", "0"))
     if rank != 0:

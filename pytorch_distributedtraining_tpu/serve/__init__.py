@@ -16,6 +16,13 @@ super-resolution) at **fixed compiled shapes**:
   lanes, fault sites.
 - :mod:`.tiles` — SwinIR request tiling: tile, batch tiles across
   requests, stitch.
+- :mod:`.router` — stdlib-only fleet control plane: membership-backed
+  request routing (p2c by queue depth), per-replica circuit breakers,
+  deadline + bounded-retry failover, SLO-burn elastic scale decisions.
+- :mod:`.fleet` — replicas as routable things: engine tick-loop threads
+  with membership heartbeats, the KV-page migration wire format, the
+  TCP dispatch plane, the ``python -m …serve.fleet`` replica process,
+  and :class:`~.fleet.ServeFleet` (``Stoke.serve_fleet``'s return).
 
 Env knobs (the ``GRAFT_SERVE_*`` family, resolved by
 :func:`serve_knobs_from_env` and consumed by ``Stoke.serve``):
@@ -51,6 +58,25 @@ SLO knobs (the ``GRAFT_SERVE_SLO_*`` family, resolved by
 ``GRAFT_SERVE_SLO_WINDOW_S``    rolling burn-rate window in seconds
                                 (default 60)
 ==============================  ===========================================
+
+Fleet knobs (consumed by ``Stoke.serve_fleet`` and the router; the full
+``GRAFT_ROUTE_*`` table lives in ``serve/router.py`` and
+``docs/SERVING.md``, the replica-process ``GRAFT_FLEET_*`` family in
+``serve/fleet.py``):
+
+==============================  ===========================================
+``GRAFT_SERVE_REPLICAS``        fleet size for ``Stoke.serve_fleet()``
+                                (default 2)
+``GRAFT_ROUTE_DEADLINE_S``      per-request routing deadline (default 30)
+``GRAFT_ROUTE_RETRIES``         dispatch attempts before shedding
+                                (default 3)
+``GRAFT_ROUTE_BACKOFF_S``       base retry backoff, doubled per attempt
+                                (default 0.05)
+``GRAFT_ROUTE_TTL_S``           heartbeat freshness for "alive" (default 5)
+``GRAFT_ROUTE_BREAKER_FAILS``   consecutive failures that open a
+                                replica's circuit breaker (default 3)
+``GRAFT_ROUTE_BREAKER_RESET_S`` breaker half-open probe delay (default 2)
+==============================  ===========================================
 """
 
 from __future__ import annotations
@@ -66,6 +92,11 @@ __all__ = [
     "serve_knobs_from_env",
     "slo_knobs_from_env",
     "build_engine",
+    "FleetRouter",
+    "ScaleController",
+    "ServeFleet",
+    "FakeEngine",
+    "route_knobs_from_env",
 ]
 
 
@@ -162,4 +193,12 @@ def __getattr__(name):
         from .tiles import SwinIRTileServer
 
         return SwinIRTileServer
+    if name in ("FleetRouter", "ScaleController", "route_knobs_from_env"):
+        from . import router as _r
+
+        return getattr(_r, name)
+    if name in ("ServeFleet", "FakeEngine"):
+        from . import fleet as _f
+
+        return getattr(_f, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

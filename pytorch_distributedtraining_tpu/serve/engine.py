@@ -64,7 +64,15 @@ from ..observe import trace
 from ..resilience.faults import InjectedFault, fault_point
 from ..runtime.cache import jit_cache_size
 from .kv_cache import PagePool
-from .scheduler import DECODE, DROPPED, AdmissionScheduler, Request
+from .scheduler import (
+    DECODE,
+    DROPPED,
+    MIGRATED,
+    PREFILL,
+    AdmissionScheduler,
+    Request,
+    RequestState,
+)
 
 # Cross-process-visible serving counters for the graftcheck runtime plane
 # (analyze/runtime_rules.py reads this via sys.modules — keep it a plain
@@ -457,6 +465,136 @@ class ServeEngine:
             ),
             "queue_s": st.admitted_s - arr,
         }
+
+    # -- decode-state migration (serve/fleet.py graceful drain) ------------
+
+    def export_decode_state(self, rids=None) -> dict:
+        """Snapshot resident DECODE-state requests for migration.
+
+        Returns ``{"format", "page_size", "requests": [meta...], "kv"}``:
+        per-request JSON-plain metadata (prompt, generated tokens, page
+        count) plus one gathered KV pytree whose leaves stack every
+        snapshot request's reserved pages in request order. Whole
+        reserved pages are copied — the cache's write-before-read
+        invariant makes the garbage tail past the valid length safe to
+        carry. Call between ticks only (no partial tick state exists).
+        """
+        want = None if rids is None else {int(r) for r in rids}
+        states = sorted(
+            (
+                st for st in self.sched.active.values()
+                if st.state == DECODE
+                and (want is None or st.rid in want)
+            ),
+            key=lambda s: s.slot,
+        )
+        metas, all_pages = [], []
+        for st in states:
+            metas.append({
+                "rid": st.rid,
+                "prompt": [int(t) for t in st.req.prompt],
+                "max_new_tokens": int(st.req.max_new_tokens),
+                "arrival_s": float(st.req.arrival_s),
+                "tokens": [int(t) for t in st.tokens],
+                "n_pages": len(st.pages),
+            })
+            all_pages.extend(st.pages)
+        kv = None
+        if all_pages:
+            idx = jnp.asarray(np.asarray(all_pages, np.int32))
+            kv = jax.tree_util.tree_map(
+                lambda leaf: np.asarray(leaf[idx]), self._pages
+            )
+        return {
+            "format": "graft-kv-migration",
+            "page_size": self.page_size,
+            "requests": metas,
+            "kv": kv,
+        }
+
+    def adopt(self, snapshot: dict) -> list[int]:
+        """Import a migration snapshot: each request lands in a free slot
+        with its KV pages scattered into this engine's pool and resumes
+        decoding at its next tick — at temperature 0 the continuation is
+        bitwise-identical to an uninterrupted run (greedy sampling is
+        rng-independent). Raises when capacity is insufficient (the
+        caller then falls back to replay-from-prompt)."""
+        if int(snapshot.get("page_size", -1)) != self.page_size:
+            raise ValueError(
+                f"page_size mismatch: snapshot "
+                f"{snapshot.get('page_size')} vs engine {self.page_size}"
+            )
+        kv = snapshot.get("kv")
+        offset = 0
+        adopted = []
+        for meta in snapshot.get("requests") or []:
+            n = int(meta["n_pages"])
+            if not self.sched.free_slots or n > self.pool.available:
+                raise RuntimeError(
+                    f"no capacity to adopt request {meta['rid']}: "
+                    f"{len(self.sched.free_slots)} free slots, "
+                    f"{self.pool.available} free pages (need {n})"
+                )
+            req = Request(
+                int(meta["rid"]),
+                np.asarray(meta["prompt"], np.int32),
+                int(meta["max_new_tokens"]),
+                arrival_s=float(meta.get("arrival_s", 0.0)),
+            )
+            slot = self.sched.free_slots.pop(0)
+            pages = self.pool.alloc(n, req.rid)
+            st = RequestState(
+                req, slot, pages, state=DECODE,
+                prefilled=req.prompt_len,
+                tokens=[int(t) for t in meta["tokens"]],
+            )
+            self.sched.active[slot] = st
+            self.sched._admit_order.append(slot)
+            row = np.zeros((self.max_pages,), np.int32)
+            row[:n] = pages
+            self._page_table[slot] = row
+            # the cache holds prompt + all generated tokens EXCEPT the
+            # newest (it is fed back as the next decode input)
+            self._lengths[slot] = req.prompt_len + len(st.tokens) - 1
+            if kv is not None and n:
+                dst = jnp.asarray(np.asarray(pages, np.int32))
+                lo, hi = offset, offset + n
+                self._pages = jax.tree_util.tree_map(
+                    lambda leaf, src: leaf.at[dst].set(
+                        jnp.asarray(src[lo:hi])
+                    ),
+                    self._pages, kv,
+                )
+            offset += n
+            self.ledger.begin(req.rid)
+            self.ledger.note_admit(req.rid, slot=slot)
+            adopted.append(req.rid)
+        return adopted
+
+    def migrate_out(self, rids=None) -> tuple[dict, list[int]]:
+        """Export resident DECODE state and retire it as MIGRATED.
+
+        Returns ``(snapshot, leftover_rids)`` — the snapshot feeds
+        :meth:`adopt` on the destination; ``leftover_rids`` are requests
+        this engine still holds queued or mid-prefill, which the caller
+        replays from the prompt instead (their sunk cost is small by
+        construction: prefill is chunked and the queue never started).
+        """
+        snap = self.export_decode_state(rids)
+        by_rid = {st.rid: st for st in self.sched.active.values()}
+        for meta in snap["requests"]:
+            st = by_rid[meta["rid"]]
+            self.sched.retire(st, state=MIGRATED)
+            self._page_table[st.slot] = 0
+            self._lengths[st.slot] = 0
+            tpc = time.perf_counter()
+            self.ledger.add_phase(st.rid, "migrate", tpc, tpc)
+            self.ledger.complete(st.rid, outcome=_slo.MIGRATED)
+        leftover = [r.rid for r in self.sched.queue] + [
+            st.rid for st in self.sched.active.values()
+            if st.state == PREFILL
+        ]
+        return snap, leftover
 
     # -- driving loops -----------------------------------------------------
 

@@ -1,0 +1,1057 @@
+"""Serve fleet: engine replicas behind the membership-backed router.
+
+``serve/router.py`` is pure control plane — it routes, retries, and
+accounts, but owns no engine. This module is everything that makes a
+*replica* a routable thing and a *fleet* a running system:
+
+- :class:`EngineReplica` — one engine (a real
+  :class:`~.engine.ServeEngine` or the stdlib :class:`FakeEngine`) driven
+  by a background tick-loop thread with a thread-safe inbox. It
+  registers a replica role record in the membership store, heartbeats it
+  (heartbeat loss IS the router's death detector), publishes the
+  ``serve_queue_depth`` / ``serve_kv_pages_free`` / ``serve_slo_burn_rate``
+  gauges through ``publish_metrics``, and polls ``drain_requested`` to
+  run the graceful-drain protocol.
+- **KV-page migration wire format** —
+  :func:`write_migration` / :func:`read_migration` serialize an engine's
+  exported decode state (``ServeEngine.export_decode_state``) through the
+  portable-checkpoint commit protocol from ``checkpoint_sharded.py``
+  (``kv/`` portable dir + ``slots.json`` metadata), so a drained
+  replica's resident requests resume on another replica with
+  bitwise-identical continuations (greedy decode is rng-independent).
+- :class:`ReplicaServer` / :func:`tcp_transport` — the line-JSON TCP
+  dispatch plane (same protocol shape as membership's ``serve_store``):
+  a router in any process submits to a replica in any process; a
+  SIGKILLed replica's sockets reset, the transport raises
+  ``ConnectionError``, and the router fails over.
+- :func:`serve_replica_main` — the ``python -m …serve.fleet`` replica
+  process: build engine, register, serve, drain on request, exit 0.
+- :class:`ServeFleet` — the in-process composition ``Stoke.serve_fleet``
+  returns: N replicas + router + :class:`~.router.ScaleController` in
+  one object with ``submit`` / ``drain`` / ``scale_tick`` / ``stop``.
+
+Stdlib-only at import time (same contract as ``runtime/membership.py``):
+jax, numpy, and the checkpoint machinery load lazily and only on the
+real-engine paths, so the chaos drill's router process and the fake-
+engine tests never pay for them.
+
+Env knobs (the replica process; ``GRAFT_ROUTE_*`` is the router's, see
+``serve/router.py``):
+
+=========================  ============================================
+``GRAFT_FLEET_STORE``      membership store location (dir or
+                           ``tcp://host:port``) — required
+``GRAFT_FLEET_REPLICA_ID`` this replica's id (default ``replica-<pid>``)
+``GRAFT_FLEET_FAKE``       1 = serve the stdlib :class:`FakeEngine`
+                           (no jax) instead of a tiny real engine
+``GRAFT_FLEET_STANDBY``    1 = register as standby capacity (routable
+                           only after a scale-out activates it)
+``GRAFT_FLEET_RANK``       metrics-plane rank for ``publish_metrics``
+                           (default 1000; keep clear of training ranks)
+``GRAFT_FLEET_DRAIN_DIR``  where drain writes migration snapshots
+``GRAFT_FLEET_TICK_DELAY_S`` fake-engine per-tick delay (default 0.005)
+=========================  ============================================
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import sys
+import threading
+import time
+
+from ..resilience.faults import InjectedFault, fault_point
+from ..runtime.membership import GrowGate, _write_json_atomic, open_store
+from .router import FleetRouter, ScaleController, route_knobs_from_env
+
+__all__ = [
+    "FakeEngine",
+    "EngineReplica",
+    "ReplicaServer",
+    "ServeFleet",
+    "tcp_transport",
+    "serve_replica",
+    "serve_replica_main",
+    "write_migration",
+    "read_migration",
+    "split_migration",
+]
+
+MIGRATION_FORMAT = "graft-kv-migration"
+
+
+# -- migration wire format -------------------------------------------------
+
+
+def write_migration(snapshot: dict, path: str) -> str:
+    """Persist one ``export_decode_state`` snapshot at ``path``:
+    ``slots.json`` (JSON-plain request metadata) next to a ``kv/``
+    portable-checkpoint dir holding the gathered page pytree. The KV
+    payload rides the commit-marker protocol from
+    ``checkpoint_sharded.py`` — a replica killed mid-drain leaves a torn
+    ``kv.tmp`` that :func:`read_migration` refuses, never a half-true
+    snapshot the destination would decode garbage from."""
+    os.makedirs(path, exist_ok=True)
+    kv = snapshot.get("kv")
+    if kv is not None:
+        from ..checkpoint_sharded import save_portable
+
+        save_portable(os.path.join(path, "kv"), kv)
+    meta = {k: v for k, v in snapshot.items() if k != "kv"}
+    meta["has_kv"] = kv is not None
+    _write_json_atomic(os.path.join(path, "slots.json"), meta)
+    return path
+
+
+def read_migration(path: str, engine=None) -> dict:
+    """Load a migration snapshot. ``engine`` (the adopting engine) is
+    required when the snapshot carries KV pages — its ``_pages`` pytree
+    is the restore template (leading dim swapped for the snapshot's
+    total page count)."""
+    with open(os.path.join(path, "slots.json"), encoding="utf-8") as fh:
+        meta = json.load(fh)
+    if meta.get("format") != MIGRATION_FORMAT:
+        raise ValueError(f"not a migration snapshot: {path}")
+    kv = None
+    if meta.pop("has_kv", False):
+        if engine is None or not hasattr(engine, "_pages"):
+            raise ValueError(
+                "snapshot carries KV pages but no paged engine was "
+                "given as the restore template"
+            )
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..checkpoint_sharded import restore_portable
+
+        n_total = sum(int(r["n_pages"]) for r in meta["requests"])
+        template = jax.tree_util.tree_map(
+            lambda leaf: jnp.zeros(
+                (n_total,) + tuple(leaf.shape[1:]), leaf.dtype
+            ),
+            engine._pages,
+        )
+        kv = jax.tree_util.tree_map(
+            lambda x: np.asarray(x),
+            restore_portable(os.path.join(path, "kv"), template),
+        )
+    return {**meta, "kv": kv}
+
+
+def split_migration(snapshot: dict, rid) -> dict:
+    """The single-request slice of a snapshot: its metadata plus its
+    contiguous page range out of the stacked KV leaves — so two
+    destinations adopting different requests from one drain never
+    double-admit each other's."""
+    offset = 0
+    for meta in snapshot.get("requests") or []:
+        n = int(meta["n_pages"])
+        if int(meta["rid"]) == int(rid):
+            kv = snapshot.get("kv")
+            if kv is not None and n:
+                lo = offset
+                import jax
+
+                kv = jax.tree_util.tree_map(
+                    lambda leaf: leaf[lo:lo + n], kv
+                )
+            return {
+                "format": snapshot.get("format", MIGRATION_FORMAT),
+                "page_size": snapshot.get("page_size", 0),
+                "requests": [meta],
+                "kv": kv,
+            }
+        offset += n
+    raise KeyError(f"request {rid} not in snapshot")
+
+
+# -- engines ----------------------------------------------------------------
+
+
+class FakeEngine:
+    """Deterministic stdlib engine double with the tick-loop surface
+    :class:`EngineReplica` drives (submit/tick/idle/migrate_out/adopt +
+    a ``delivered`` list). Token ``i`` of a request is a pure function
+    of its prompt, so replay on another replica and KV-less migration
+    both land the exact token stream an uninterrupted run would —
+    mirroring the real engine's greedy (temperature-0) determinism."""
+
+    page_size = 0
+
+    def __init__(
+        self,
+        n_slots: int = 4,
+        tokens_per_tick: int = 1,
+        tick_delay_s: float = 0.0,
+    ):
+        self.n_slots = int(n_slots)
+        self.tokens_per_tick = max(1, int(tokens_per_tick))
+        self.tick_delay_s = float(tick_delay_s)
+        self.queue: list[dict] = []
+        self.active: dict = {}   # rid -> request dict (with "tokens")
+        self.delivered: list[dict] = []
+        self.migrated: list[dict] = []
+        self.ticks = 0
+
+    @staticmethod
+    def token(prompt, i: int) -> int:
+        return (sum(int(t) for t in prompt) * 31 + i * 7 + 1) % 50257
+
+    def submit(self, req: dict) -> None:
+        self.queue.append(dict(req))
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and not self.active
+
+    def tick(self, now: float = 0.0) -> None:
+        if self.tick_delay_s:
+            time.sleep(self.tick_delay_s)
+        while self.queue and len(self.active) < self.n_slots:
+            r = self.queue.pop(0)
+            r.setdefault("tokens", [])
+            self.active[int(r["rid"])] = r
+        for r in list(self.active.values()):
+            for _ in range(self.tokens_per_tick):
+                if len(r["tokens"]) >= int(r["max_new_tokens"]):
+                    break
+                r["tokens"].append(self.token(r["prompt"], len(r["tokens"])))
+            if len(r["tokens"]) >= int(r["max_new_tokens"]):
+                del self.active[int(r["rid"])]
+                self.delivered.append(
+                    {"rid": int(r["rid"]), "tokens": list(r["tokens"])}
+                )
+        self.ticks += 1
+
+    def gauges(self) -> dict:
+        return {
+            "serve_queue_depth": float(len(self.queue)),
+            "serve_slot_occupancy": len(self.active) / self.n_slots,
+            "serve_kv_pages_free": 0.0,
+            "serve_slo_burn_rate": 0.0,
+        }
+
+    def migrate_out(self, rids=None) -> tuple[dict, list]:
+        want = None if rids is None else {int(r) for r in rids}
+        metas = []
+        for rid, r in sorted(self.active.items()):
+            if want is not None and rid not in want:
+                continue
+            metas.append({
+                "rid": rid,
+                "prompt": [int(t) for t in r["prompt"]],
+                "max_new_tokens": int(r["max_new_tokens"]),
+                "arrival_s": float(r.get("arrival_s", 0.0)),
+                "tokens": list(r["tokens"]),
+                "n_pages": 0,
+            })
+            self.migrated.append(self.active.pop(rid))
+        snap = {
+            "format": MIGRATION_FORMAT, "page_size": 0,
+            "requests": metas, "kv": None,
+        }
+        return snap, [int(q["rid"]) for q in self.queue]
+
+    def adopt(self, snapshot: dict) -> list:
+        adopted = []
+        for meta in snapshot.get("requests") or []:
+            rid = int(meta["rid"])
+            self.active[rid] = {
+                "rid": rid,
+                "prompt": list(meta["prompt"]),
+                "max_new_tokens": int(meta["max_new_tokens"]),
+                "tokens": list(meta["tokens"]),
+            }
+            adopted.append(rid)
+        return adopted
+
+
+class EngineReplica:
+    """One engine behind a thread-safe dispatch surface + membership.
+
+    The tick loop is the ONLY thread that touches the engine (neither
+    engine kind is thread-safe); :meth:`submit` and
+    :meth:`adopt_and_finish` hand work over through locked inboxes and
+    wait on per-request completion events.
+
+    Lifecycle: :meth:`start` registers the loop; a ``request_drain`` in
+    the store flips the replica into drain mode — it finishes its queue
+    and prefills, exports resident decode state through the migration
+    wire format, answers every still-waiting dispatcher with the
+    ``{"migrated": True, "snapshot": …}`` handoff, deregisters, and
+    stops. :meth:`kill` is the chaos path: the loop halts mid-stride,
+    waiters get ``ConnectionResetError`` (exactly what a SIGKILLed
+    process's TCP peers see), and the role record ages out of the
+    membership TTL — nothing graceful happens, on purpose.
+    """
+
+    def __init__(
+        self,
+        engine,
+        replica_id: str,
+        *,
+        store=None,
+        host_id: str = "",
+        rank: int = 1000,
+        address: str = "",
+        standby: bool = False,
+        heartbeat_s: float = 0.25,
+        drain_dir: str | None = None,
+        clock=time.monotonic,
+    ):
+        self.engine = engine
+        self.replica_id = str(replica_id)
+        self.store = store
+        self.host_id = host_id or self.replica_id
+        self.rank = int(rank)
+        self.address = address
+        self.standby = bool(standby)
+        self.heartbeat_s = float(heartbeat_s)
+        self.drain_dir = drain_dir
+        self._clock = clock
+        self._real = hasattr(engine, "sched")  # ServeEngine vs FakeEngine
+        self._lock = threading.Lock()
+        self._inbox: list[dict] = []
+        self._adopt_inbox: list[tuple] = []  # (snapshot_path, rid)
+        self._waiters: dict = {}             # rid -> threading.Event
+        self._results: dict = {}             # rid -> result dict
+        self._migration_cache: dict = {}     # path -> loaded snapshot
+        self._adopted: set = set()
+        self._stop = threading.Event()
+        self._dead = False
+        self.draining = False
+        self.drained = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._i_delivered = 0
+        self._i_cancelled = 0
+        self._i_dropped = 0
+
+    # -- public dispatch surface -------------------------------------------
+
+    def submit(self, request: dict, timeout_s: float = 30.0) -> dict:
+        """Blocking dispatch: enqueue and wait for this request's
+        terminal answer. Raises ``ConnectionResetError`` when the
+        replica died (chaos kill), ``TimeoutError`` past ``timeout_s``;
+        a drain answers with the migration handoff dict instead."""
+        if self._dead:
+            raise ConnectionResetError(
+                f"replica {self.replica_id} is dead"
+            )
+        rid = int(request["rid"])
+        ev = threading.Event()
+        with self._lock:
+            if self.draining or self._stop.is_set():
+                return {"ok": False, "draining": True, "rid": rid}
+            self._waiters[rid] = ev
+            self._inbox.append(dict(request))
+        if not ev.wait(timeout_s):
+            with self._lock:
+                self._waiters.pop(rid, None)
+            raise TimeoutError(
+                f"replica {self.replica_id}: request {rid} not terminal "
+                f"within {timeout_s:.3f}s"
+            )
+        with self._lock:
+            res = self._results.pop(rid)
+        if res.get("reset"):
+            raise ConnectionResetError(
+                f"replica {self.replica_id} died with request {rid} "
+                "in flight"
+            )
+        return res
+
+    def adopt_and_finish(
+        self, snapshot_path: str, rid, timeout_s: float = 30.0
+    ) -> dict:
+        """Adopt one request out of a migration snapshot and block until
+        this engine delivers it — the destination half of the drain
+        handoff."""
+        if self._dead:
+            raise ConnectionResetError(
+                f"replica {self.replica_id} is dead"
+            )
+        rid = int(rid)
+        ev = threading.Event()
+        with self._lock:
+            if self.draining or self._stop.is_set():
+                return {"ok": False, "draining": True, "rid": rid}
+            self._waiters[rid] = ev
+            self._adopt_inbox.append((snapshot_path, rid))
+        if not ev.wait(timeout_s):
+            with self._lock:
+                self._waiters.pop(rid, None)
+            raise TimeoutError(
+                f"replica {self.replica_id}: adopted request {rid} not "
+                f"terminal within {timeout_s:.3f}s"
+            )
+        with self._lock:
+            res = self._results.pop(rid)
+        if res.get("reset"):
+            raise ConnectionResetError(
+                f"replica {self.replica_id} died with adopted request "
+                f"{rid} in flight"
+            )
+        return res
+
+    def health(self) -> dict:
+        doc = {"replica_id": self.replica_id, "draining": self.draining}
+        doc.update(self._gauges())
+        if self._real:
+            occ = self.engine.sched.occupancy()
+            doc["pages_in_use"] = occ["pages_in_use"]
+            doc["pages_capacity"] = occ["pages_capacity"]
+            doc["idle"] = self.engine.sched.idle
+        else:
+            doc["pages_in_use"] = 0
+            doc["pages_capacity"] = 0
+            doc["idle"] = self.engine.idle
+        return doc
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "EngineReplica":
+        if self.store is not None:
+            self.store.register_replica(
+                replica_id=self.replica_id, host_id=self.host_id,
+                address=self.address, standby=self.standby,
+            )
+        self._thread = threading.Thread(
+            target=self._loop, name=f"replica-{self.replica_id}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        """Graceful stop without drain: loop exits, open waiters are
+        answered with a refusal (router retries elsewhere)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+
+    def kill(self) -> None:
+        """Chaos: die the way SIGKILL dies. No drain, no deregister —
+        waiters see a connection reset, membership sees silence."""
+        self._dead = True
+        self._stop.set()
+        with self._lock:
+            for rid, ev in list(self._waiters.items()):
+                self._results[rid] = {"ok": False, "reset": True}
+                ev.set()
+            self._waiters.clear()
+
+    def join(self, timeout_s: float | None = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+
+    # -- tick loop ----------------------------------------------------------
+
+    def _set_result(self, rid: int, res: dict) -> None:
+        with self._lock:
+            self._results[int(rid)] = res
+            ev = self._waiters.pop(int(rid), None)
+        if ev is not None:
+            ev.set()
+
+    def _gauges(self) -> dict:
+        with self._lock:
+            backlog = len(self._inbox)
+        if self._real:
+            eng = self.engine
+            return {
+                "serve_queue_depth": float(
+                    len(eng.sched.queue) + backlog
+                ),
+                "serve_slot_occupancy":
+                    len(eng.sched.active) / eng.n_slots,
+                "serve_kv_pages_free": float(eng.pool.available),
+                "serve_slo_burn_rate": eng.slo.burn_rate(),
+            }
+        g = self.engine.gauges()
+        g["serve_queue_depth"] += backlog
+        return g
+
+    def _submit_engine(self, req: dict) -> None:
+        try:
+            if self._real:
+                from .scheduler import Request
+
+                self.engine.submit(Request(
+                    int(req["rid"]), req["prompt"],
+                    int(req["max_new_tokens"]),
+                    arrival_s=float(req.get("arrival_s", 0.0)),
+                ))
+            else:
+                self.engine.submit(req)
+        except Exception as e:  # noqa: BLE001 — answered, never fatal
+            self._set_result(int(req["rid"]), {
+                "ok": False, "error": f"{type(e).__name__}: {e}",
+            })
+
+    def _collect(self) -> None:
+        eng = self.engine
+        while self._i_delivered < len(eng.delivered):
+            rec = eng.delivered[self._i_delivered]
+            self._i_delivered += 1
+            self._set_result(int(rec["rid"]), {
+                "ok": True, "rid": int(rec["rid"]),
+                "tokens": list(rec["tokens"]),
+                "replica": self.replica_id,
+            })
+        if not self._real:
+            return
+        while self._i_cancelled < len(eng.cancelled):
+            rid = eng.cancelled[self._i_cancelled]
+            self._i_cancelled += 1
+            self._set_result(int(rid), {
+                "ok": False, "cancelled": True, "rid": int(rid),
+            })
+        while self._i_dropped < len(eng.sched.dropped):
+            req = eng.sched.dropped[self._i_dropped]
+            self._i_dropped += 1
+            self._set_result(int(req.rid), {
+                "ok": False, "shed": True, "rid": int(req.rid),
+            })
+
+    def _engine_idle(self) -> bool:
+        if self._real:
+            return self.engine.sched.idle
+        return self.engine.idle
+
+    def _adopt(self, path: str, rid: int) -> None:
+        try:
+            if rid in self._adopted:
+                return
+            snap = self._migration_cache.get(path)
+            if snap is None:
+                snap = read_migration(
+                    path, self.engine if self._real else None
+                )
+                self._migration_cache[path] = snap
+            self.engine.adopt(split_migration(snap, rid))
+            self._adopted.add(rid)
+        except Exception as e:  # noqa: BLE001 — answered, never fatal
+            self._set_result(rid, {
+                "ok": False, "error": f"{type(e).__name__}: {e}",
+            })
+
+    def _publish(self) -> None:
+        try:
+            # kwargs throughout: the store may be a TCPMembershipStore
+            # proxy, whose RPC surface is keyword-only
+            self.store.replica_heartbeat(replica_id=self.replica_id)
+            self.store.publish_metrics(
+                host_id=self.host_id, rank=self.rank, doc={
+                    "replica_id": self.replica_id,
+                    "gauges": self._gauges(),
+                },
+            )
+            if not self.draining and self.store.drain_requested(
+                replica_id=self.replica_id
+            ):
+                with self._lock:
+                    self.draining = True
+        except (KeyError, OSError, RuntimeError):
+            pass  # store hiccups never take the engine down
+
+    def _loop(self) -> None:
+        eng = self.engine
+        if self._real and not eng._warm:
+            eng.warmup()
+            eng.mark_steady()
+        t0 = self._clock()
+        last_pub = 0.0
+        while not self._stop.is_set():
+            # the chaos matrix's replica-death site: a {"action": "kill"}
+            # plan entry dies here, mid-loop, exactly like SIGKILL
+            fault_point("replica.kill", replica=self.replica_id)
+            with self._lock:
+                inbox, self._inbox = self._inbox, []
+                adopts, self._adopt_inbox = self._adopt_inbox, []
+                draining = self.draining
+            for path, rid in adopts:
+                self._adopt(path, rid)
+            for req in inbox:
+                self._submit_engine(req)
+            if not self._engine_idle():
+                eng.tick(self._clock() - t0)
+            else:
+                time.sleep(0.001)
+            self._collect()
+            if (
+                self.store is not None
+                and self._clock() - last_pub >= self.heartbeat_s
+            ):
+                last_pub = self._clock()
+                self._publish()
+                draining = draining or self.draining
+            if draining:
+                self._drain()
+                return
+        # plain stop: answer whoever is still waiting with a refusal
+        with self._lock:
+            for rid, ev in list(self._waiters.items()):
+                self._results[rid] = {
+                    "ok": False, "draining": True, "rid": rid,
+                }
+                ev.set()
+            self._waiters.clear()
+
+    def _drain(self) -> None:
+        """Graceful drain: finish the cheap state (queue + prefill),
+        migrate the expensive state (resident decode), answer every
+        waiting dispatcher, deregister, stop."""
+        eng = self.engine
+        t0 = self._clock()
+        # queued/prefilling requests cost little to finish locally —
+        # chunked prefill means a handful of ticks each; resident decode
+        # is the state worth shipping
+        def _cheap_state():
+            if self._real:
+                from .scheduler import PREFILL
+
+                return bool(eng.sched.queue) or any(
+                    st.state == PREFILL
+                    for st in eng.sched.active.values()
+                )
+            return bool(eng.queue)
+
+        while _cheap_state() and not self._stop.is_set():
+            eng.tick(self._clock() - t0)
+            self._collect()
+        snap_path = None
+        try:
+            fault_point("replica.drain", replica=self.replica_id)
+            snap, leftover = eng.migrate_out()
+        except InjectedFault:
+            # the drill's forced-replay arm: no snapshot, everything
+            # resident is handed back to the router as a refusal
+            snap, leftover = None, [
+                int(rid) for rid in self._resident_rids()
+            ]
+        if snap and snap["requests"] and self.drain_dir:
+            snap_path = write_migration(
+                snap,
+                os.path.join(
+                    self.drain_dir, f"migrate_{self.replica_id}"
+                ),
+            )
+        for meta in (snap["requests"] if snap else []):
+            self._set_result(int(meta["rid"]), {
+                "ok": False, "migrated": True, "rid": int(meta["rid"]),
+                "snapshot": snap_path, "replica": self.replica_id,
+            })
+        for rid in leftover:
+            self._set_result(int(rid), {
+                "ok": False, "draining": True, "rid": int(rid),
+            })
+        self._collect()
+        with self._lock:
+            for rid, ev in list(self._waiters.items()):
+                self._results[rid] = {
+                    "ok": False, "draining": True, "rid": rid,
+                }
+                ev.set()
+            self._waiters.clear()
+        if self.store is not None:
+            try:
+                self.store.deregister_replica(
+                    replica_id=self.replica_id, reason="drained"
+                )
+            except (OSError, RuntimeError):
+                pass
+        self.drained.set()
+        self._stop.set()
+
+    def _resident_rids(self) -> list:
+        if self._real:
+            return [st.rid for st in self.engine.sched.active.values()]
+        return list(self.engine.active.keys())
+
+
+# -- TCP dispatch plane -----------------------------------------------------
+
+
+class _ReplicaRequestHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        for raw in self.rfile:
+            try:
+                req = json.loads(raw)
+                op = req.get("op")
+                replica = self.server.replica
+                if op == "submit":
+                    resp = replica.submit(
+                        req["request"],
+                        float(req.get("timeout_s", 30.0)),
+                    )
+                elif op == "adopt_and_finish":
+                    resp = replica.adopt_and_finish(
+                        req["snapshot"], req["rid"],
+                        float(req.get("timeout_s", 30.0)),
+                    )
+                elif op == "health":
+                    resp = {"ok": True, **replica.health()}
+                else:
+                    resp = {"ok": False, "error": f"unknown op {op!r}"}
+            except Exception as e:  # noqa: BLE001 — serialized back
+                resp = {
+                    "ok": False,
+                    "error": f"{type(e).__name__}: {e}",
+                    "error_type": type(e).__name__,
+                }
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class ReplicaServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve_replica(
+    replica: EngineReplica, host: str = "127.0.0.1", port: int = 0,
+) -> tuple[ReplicaServer, threading.Thread]:
+    """Expose ``replica`` over line-JSON TCP; returns (server, thread).
+    ``server.server_address`` carries the bound (host, port)."""
+    server = ReplicaServer((host, port), _ReplicaRequestHandler)
+    server.replica = replica
+    thread = threading.Thread(
+        target=server.serve_forever,
+        name=f"replica-server-{replica.replica_id}", daemon=True,
+    )
+    thread.start()
+    return server, thread
+
+
+def _rpc(address: str, doc: dict, timeout_s: float):
+    addr = address[len("tcp://"):] if address.startswith("tcp://") else address
+    host, _, port = addr.rpartition(":")
+    with socket.create_connection(
+        (host, int(port)), timeout=timeout_s
+    ) as sock:
+        sock.settimeout(timeout_s)
+        sock.sendall((json.dumps(doc) + "\n").encode())
+        with sock.makefile("r", encoding="utf-8") as fh:
+            line = fh.readline()
+    if not line:
+        raise ConnectionResetError(f"replica at {address} closed mid-call")
+    return json.loads(line)
+
+
+def tcp_transport(replica, request: dict, timeout_s: float) -> dict:
+    """The router's dispatch primitive over the TCP plane: blocks until
+    the replica's terminal answer. A dead replica raises
+    ``ConnectionError``/``socket.timeout`` — outage-class, so the router
+    fails over. Responses that carry a remote-side timeout re-raise as
+    ``TimeoutError`` for the same reason."""
+    resp = _rpc(
+        replica.address,
+        {"op": "submit", "request": request, "timeout_s": timeout_s},
+        # the socket outlives the remote wait slightly, so a remote
+        # timeout surfaces as a structured response, not a raw cutoff
+        timeout_s + 2.0,
+    )
+    if resp.get("error_type") == "TimeoutError":
+        raise TimeoutError(resp.get("error", "remote timeout"))
+    return resp
+
+
+def tcp_health(address: str, timeout_s: float = 5.0) -> dict:
+    return _rpc(address, {"op": "health"}, timeout_s)
+
+
+def tcp_migrate_handler(router: FleetRouter):
+    """Migrate handler for TCP fleets: adopt the drained snapshot on the
+    least-loaded surviving replica and wait out its completion."""
+
+    def handler(resp: dict, request: dict):
+        if not resp.get("snapshot"):
+            return None
+        dest = router.pick(exclude={resp.get("replica")})
+        if dest is None or not dest.address:
+            return None
+        return _rpc(dest.address, {
+            "op": "adopt_and_finish",
+            "snapshot": resp["snapshot"],
+            "rid": request["rid"],
+            "timeout_s": router.deadline_s,
+        }, router.deadline_s + 2.0)
+
+    return handler
+
+
+# -- replica process entry --------------------------------------------------
+
+
+def _tiny_engine():
+    """The drill's real-engine replica: a tiny GPT-2 decode engine with
+    deterministic params (same seed on every replica, so replayed and
+    migrated requests continue bitwise-identically at temperature 0)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.gpt2 import GPT2, GPT2Config
+    from .engine import ServeEngine
+
+    cfg = GPT2Config.tiny(n_embd=32, n_head=4, n_positions=96)
+    model = GPT2(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return ServeEngine(
+        cfg, params, n_slots=2, page_size=8, max_len=64,
+        prefill_chunk=8, prefill_buckets=(8,), temperature=0.0,
+    )
+
+
+def serve_replica_main(env=None) -> int:
+    """``python -m pytorch_distributedtraining_tpu.serve.fleet``: one
+    replica process — build engine, register, serve until drained."""
+    e = os.environ if env is None else env
+    store_loc = (e.get("GRAFT_FLEET_STORE") or "").strip()
+    if not store_loc:
+        print(json.dumps({
+            "event": "error", "reason": "GRAFT_FLEET_STORE not set",
+        }), flush=True)
+        return 2
+    replica_id = (
+        e.get("GRAFT_FLEET_REPLICA_ID") or f"replica-{os.getpid()}"
+    )
+    fake = (e.get("GRAFT_FLEET_FAKE") or "").strip() == "1"
+    standby = (e.get("GRAFT_FLEET_STANDBY") or "").strip() == "1"
+    drain_dir = (e.get("GRAFT_FLEET_DRAIN_DIR") or "").strip() or None
+    rank = int(e.get("GRAFT_FLEET_RANK") or 1000)
+    try:
+        store = open_store(store_loc)
+        if fake:
+            engine = FakeEngine(
+                n_slots=4,
+                tick_delay_s=float(
+                    e.get("GRAFT_FLEET_TICK_DELAY_S") or 0.005
+                ),
+            )
+        else:
+            engine = _tiny_engine()
+    except Exception as exc:  # noqa: BLE001 — structured for the drill
+        print(json.dumps({
+            "event": "error", "replica_id": replica_id,
+            "reason": f"{type(exc).__name__}: {exc}",
+        }), flush=True)
+        return 3
+    server, _ = serve_replica(
+        EngineReplica(
+            engine, replica_id, store=store, rank=rank,
+            standby=standby, drain_dir=drain_dir,
+        ),
+        port=int(e.get("GRAFT_FLEET_PORT") or 0),
+    )
+    replica = server.replica
+    host, port = server.server_address[:2]
+    replica.address = f"tcp://{host}:{port}"
+    store.register_replica(
+        replica_id=replica_id, host_id=replica.host_id,
+        address=replica.address, standby=standby,
+    )
+    replica.start()
+    print(json.dumps({
+        "event": "replica_up", "replica_id": replica_id,
+        "address": replica.address, "fake": fake, "pid": os.getpid(),
+    }), flush=True)
+    replica.join()  # until drained (or killed, in which case: no exit)
+    server.shutdown()
+    print(json.dumps({
+        "event": "replica_exit", "replica_id": replica_id,
+        "drained": replica.drained.is_set(),
+    }), flush=True)
+    return 0
+
+
+# -- in-process fleet -------------------------------------------------------
+
+
+class ServeFleet:
+    """N in-process replicas + router + scale controller in one object —
+    what ``Stoke.serve_fleet()`` hands back.
+
+    ``engines`` maps replica id -> engine (real or fake); ``standby``
+    likewise for registered-but-not-serving capacity the scale
+    controller can admit. The membership store defaults to a private
+    directory under ``root``.
+    """
+
+    def __init__(
+        self,
+        engines: dict,
+        *,
+        standby: dict | None = None,
+        store=None,
+        root: str | None = None,
+        route_knobs: dict | None = None,
+        gate: GrowGate | None = None,
+        burn_high: float = 1.0,
+        burn_low: float = 0.25,
+        drain_probes: int = 3,
+        min_replicas: int = 1,
+        heartbeat_s: float = 0.1,
+        clock=time.monotonic,
+    ):
+        if store is None:
+            import tempfile
+
+            from ..runtime.membership import MembershipStore
+
+            root = root or tempfile.mkdtemp(prefix="graft-fleet-")
+            store = MembershipStore(root, ttl_s=5.0)
+        self.store = store
+        self.root = root
+        drain_dir = None
+        if root:
+            drain_dir = os.path.join(root, "migrations")
+            os.makedirs(drain_dir, exist_ok=True)
+        self.replicas: dict[str, EngineReplica] = {}
+        for i, (rid, eng) in enumerate(engines.items()):
+            self.replicas[rid] = EngineReplica(
+                eng, rid, store=store, rank=1000 + i,
+                heartbeat_s=heartbeat_s, drain_dir=drain_dir,
+                clock=clock,
+            )
+        for i, (rid, eng) in enumerate((standby or {}).items()):
+            self.replicas[rid] = EngineReplica(
+                eng, rid, store=store, rank=2000 + i, standby=True,
+                heartbeat_s=heartbeat_s, drain_dir=drain_dir,
+                clock=clock,
+            )
+        knobs = dict(route_knobs_from_env())
+        knobs.update(route_knobs or {})
+        self.router = FleetRouter(
+            store, self._transport,
+            migrate_handler=self._migrate, clock=clock, **knobs,
+        )
+        self.controller = ScaleController(
+            store, gate=gate, burn_high=burn_high, burn_low=burn_low,
+            drain_probes=drain_probes, min_replicas=min_replicas,
+            clock=clock,
+        )
+
+    # -- wiring -------------------------------------------------------------
+
+    def _transport(self, info, request: dict, timeout_s: float) -> dict:
+        rep = self.replicas.get(info.replica_id)
+        if rep is None:
+            raise ConnectionError(
+                f"no such replica {info.replica_id!r}"
+            )
+        return rep.submit(request, timeout_s)
+
+    def _migrate(self, resp: dict, request: dict):
+        if not resp.get("snapshot"):
+            return None
+        dest = self.router.pick(exclude={resp.get("replica")})
+        if dest is None:
+            return None
+        rep = self.replicas.get(dest.replica_id)
+        if rep is None:
+            return None
+        return rep.adopt_and_finish(
+            resp["snapshot"], request["rid"],
+            timeout_s=self.router.deadline_s,
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, wait_s: float = 5.0) -> "ServeFleet":
+        for rep in self.replicas.values():
+            rep.start()
+        want = sum(1 for r in self.replicas.values() if not r.standby)
+        deadline = time.monotonic() + wait_s
+        while (
+            len(self.router.replicas()) < want
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        return self
+
+    def submit(self, request: dict) -> dict:
+        return self.router.submit(request)
+
+    def drain(self, replica_id: str, timeout_s: float = 30.0) -> bool:
+        """Graceful scale-in of one replica: drains to zero resident
+        requests (finish or migrate), deregisters, stops. Returns True
+        when the drain completed inside ``timeout_s``."""
+        self.store.request_drain(replica_id=replica_id, reason="scale_in")
+        rep = self.replicas.get(replica_id)
+        if rep is None:
+            return False
+        return rep.drained.wait(timeout_s)
+
+    def scale_tick(self):
+        """One elastic-control tick: read the fleet, maybe act. Returns
+        the controller's decision (``("scale_out"|"scale_in", id)`` or
+        None) after applying it."""
+        standbys = [
+            r for r in self.store.replicas(include_standby=True)
+            if r.get("standby")
+        ]
+        decision = self.controller.observe(
+            self.router.replicas(), standbys
+        )
+        if decision is None:
+            return None
+        action, rid = decision
+        if action == "scale_out":
+            rec = next(
+                (r for r in standbys if r["replica_id"] == rid), None
+            )
+            if rec is not None:
+                # activation = re-registering without the standby mark;
+                # the router's next snapshot routes to it
+                self.store.register_replica(
+                    replica_id=rid, host_id=rec.get("host_id", ""),
+                    address=rec.get("address", ""), standby=False,
+                )
+                rep = self.replicas.get(rid)
+                if rep is not None:
+                    rep.standby = False
+            self.store.record_transition(
+                kind="fleet_scale_out", replica=rid
+            )
+        elif action == "scale_in":
+            self.store.request_drain(
+                replica_id=rid, reason="slo_headroom"
+            )
+            self.store.record_transition(
+                kind="fleet_scale_in", replica=rid
+            )
+        return decision
+
+    def kill(self, replica_id: str) -> None:
+        """Chaos: SIGKILL-equivalent on an in-process replica."""
+        rep = self.replicas[replica_id]
+        rep.kill()
+
+    def stop(self) -> dict:
+        for rep in self.replicas.values():
+            rep.stop()
+        return self.metrics()
+
+    def metrics(self) -> dict:
+        out = self.router.metrics()
+        out["replicas"] = {
+            rid: rep.health() for rid, rep in self.replicas.items()
+            if not rep._dead
+        }
+        return out
+
+    def __enter__(self) -> "ServeFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(serve_replica_main())

@@ -1,0 +1,640 @@
+"""Fleet router: membership-backed admission + failover for serve replicas.
+
+One :class:`~.engine.ServeEngine` is a single process with a single KV
+pool — not "millions of users", and not survivable: SIGKILL it and every
+in-flight request hangs forever in its caller. This module is the control
+plane that makes N engine replicas behave like one service that *cannot*
+hang a request:
+
+- **Replica discovery** rides :class:`~..runtime.membership.MembershipStore`
+  (file or TCP backend, unchanged): replicas register role records
+  (``register_replica``), heartbeat them, and publish their
+  ``serve_queue_depth`` / ``serve_kv_pages_free`` / ``serve_slo_burn_rate``
+  gauges through ``publish_metrics`` — the router never talks to a replica
+  it cannot see a fresh heartbeat for.
+- **Load balancing** is power-of-two-choices by queue depth (ties broken
+  toward more free KV pages): two random candidates, pick the less loaded
+  — the classic p2c result (exponential improvement over random placement
+  at two probes' cost) without global queue state.
+- **Admission** gives every request a deadline (``GRAFT_ROUTE_DEADLINE_S``)
+  and a bounded retry budget with exponential backoff
+  (:class:`~..resilience.outage.RetryPolicy` semantics, deterministic
+  jitter); each replica sits behind its own
+  :class:`~..resilience.outage.CircuitBreaker`, so a dying replica stops
+  receiving dispatches after ``failure_threshold`` consecutive failures
+  instead of eating the whole retry budget of every request.
+- **Failover**: a dispatch that dies mid-decode (connection reset, replica
+  SIGKILLed, membership TTL expiry) is *re-dispatched from the prompt* to
+  another replica (replay — decode is deterministic at temperature 0, and
+  the prompt is the request); a request whose deadline or retry budget is
+  exhausted is terminally **shed**. Either way the lifecycle closes in the
+  router's :class:`~..observe.slo.RequestLedger`: terminal state ∈
+  {delivered, shed, migrated}, phases sum to wall. The graceful path
+  (scale-in drain) migrates resident decode state instead — see
+  ``serve/fleet.py`` for the KV-page wire format.
+- **Elastic scaling** closes the loop on ``observe/slo.py``: sustained
+  burn rate > 1x admits a quarantine-cleared standby replica through the
+  same :class:`~..runtime.membership.GrowGate` hysteresis the elastic
+  launcher uses (K consecutive probes + a minimum interval, so a latency
+  blip cannot thrash the fleet), and sustained budget headroom scales in
+  via graceful drain (:class:`ScaleController`).
+
+Stdlib-only by contract, same discipline as ``runtime/membership.py``:
+the router process, the chaos drill, and the graftcheck runtime plane
+(``router-hang``) all run it jax-free. Transports are injected callables
+— ``serve/fleet.py`` provides the in-process and line-JSON TCP ones.
+
+Env knobs (the ``GRAFT_ROUTE_*`` family, resolved by
+:func:`route_knobs_from_env`; ``GRAFT_SERVE_REPLICAS`` is consumed by
+``Stoke.serve_fleet``):
+
+==============================  ===========================================
+``GRAFT_ROUTE_DEADLINE_S``      per-request wall deadline (default 30)
+``GRAFT_ROUTE_RETRIES``         total dispatch attempts per request
+                                (default 3)
+``GRAFT_ROUTE_BACKOFF_S``       base retry backoff, doubled per attempt
+                                (default 0.05)
+``GRAFT_ROUTE_TTL_S``           replica liveness TTL for routing decisions
+                                (default 5; membership's own TTL still
+                                gates registration)
+``GRAFT_ROUTE_BREAKER_FAILS``   consecutive failures that open a replica's
+                                breaker (default 3)
+``GRAFT_ROUTE_BREAKER_RESET_S`` breaker open->half-open timeout (default 2)
+==============================  ===========================================
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..observe import slo as _slo
+from ..resilience.faults import InjectedFault, fault_point
+from ..resilience.outage import (
+    CircuitBreaker,
+    OutageClass,
+    classify_exception,
+)
+from ..runtime.membership import GrowGate
+
+__all__ = [
+    "ReplicaInfo",
+    "FleetRouter",
+    "ScaleController",
+    "route_knobs_from_env",
+    "runtime_stats",
+    "rolling_gauges",
+]
+
+# graftcheck's runtime plane (analyze/runtime_rules.py ``router-hang``)
+# reads this via sys.modules — plain dict of plain scalars/containers.
+# ``inflight`` maps rid -> the time.monotonic() of its first dispatch;
+# an entry older than ``deadline_s`` with the router still running is the
+# ERROR condition (a request the never-hang contract lost track of).
+runtime_stats: dict = {
+    "deadline_s": None,
+    "inflight": {},        # rid -> t_first_dispatch (time.monotonic())
+    "dispatched": 0,
+    "delivered": 0,
+    "replayed": 0,
+    "migrated": 0,
+    "shed": 0,
+    "failovers": 0,
+    "retries": 0,
+}
+
+# Rolling router gauges for the fleet metrics plane — same sys.modules
+# contract as serve/engine.py's: observe/fleet.py's RankMetricsPublisher
+# reads this dict without importing anything.
+rolling_gauges: dict = {}
+
+
+def reset_runtime_stats() -> None:
+    runtime_stats.update(
+        deadline_s=None, inflight={}, dispatched=0, delivered=0,
+        replayed=0, migrated=0, shed=0, failovers=0, retries=0,
+    )
+    rolling_gauges.clear()
+
+
+def _tracer():
+    """observe.trace via sys.modules — never imported (stdlib contract)."""
+    return sys.modules.get("pytorch_distributedtraining_tpu.observe.trace")
+
+
+def _instant(name: str, **attrs) -> None:
+    tr = _tracer()
+    if tr is None:
+        return
+    try:
+        if tr.enabled():
+            tr.instant(name, "membership", **attrs)
+    except Exception:
+        pass  # routing semantics never depend on telemetry health
+
+
+def route_knobs_from_env(env=None) -> dict:
+    """Resolve the ``GRAFT_ROUTE_*`` knob family into
+    :class:`FleetRouter` kwargs."""
+    e = os.environ if env is None else env
+
+    def _f(name, default):
+        raw = (e.get(name) or "").strip()
+        return float(raw) if raw else default
+
+    return dict(
+        deadline_s=_f("GRAFT_ROUTE_DEADLINE_S", 30.0),
+        retries=int(_f("GRAFT_ROUTE_RETRIES", 3)),
+        backoff_s=_f("GRAFT_ROUTE_BACKOFF_S", 0.05),
+        ttl_s=_f("GRAFT_ROUTE_TTL_S", 5.0),
+        breaker_fails=int(_f("GRAFT_ROUTE_BREAKER_FAILS", 3)),
+        breaker_reset_s=_f("GRAFT_ROUTE_BREAKER_RESET_S", 2.0),
+    )
+
+
+@dataclass
+class ReplicaInfo:
+    """The router's view of one replica: role record joined with its
+    latest published gauges (both through the membership store)."""
+
+    replica_id: str
+    host_id: str = ""
+    address: str = ""          # transport address ("tcp://h:p" or "")
+    draining: bool = False
+    queue_depth: float = 0.0
+    kv_pages_free: float = 0.0
+    slo_burn_rate: float = 0.0
+    t: float = 0.0             # store-clock stamp of the freshest fact
+    doc: dict = field(default_factory=dict)
+
+
+class FleetRouter:
+    """Admit and load-balance requests across registered serve replicas.
+
+    ``transport(replica, request, timeout_s) -> response dict`` is the
+    injected dispatch primitive: it blocks until the replica delivers
+    (``{"ok": True, "tokens": [...]}``) and raises on failure — the
+    router owns WHAT failure means (classify, breaker, retry, deadline),
+    the transport owns only the wire. ``clock``/``sleep`` are injectable
+    so every retry/deadline test runs on a fake clock.
+    """
+
+    def __init__(
+        self,
+        store,
+        transport,
+        *,
+        deadline_s: float = 30.0,
+        retries: int = 3,
+        backoff_s: float = 0.05,
+        ttl_s: float = 5.0,
+        breaker_fails: int = 3,
+        breaker_reset_s: float = 2.0,
+        seed: int = 0,
+        ledger: "_slo.RequestLedger | None" = None,
+        migrate_handler=None,
+        clock=time.monotonic,
+        sleep=time.sleep,
+    ):
+        self.store = store
+        self.transport = transport
+        self.deadline_s = float(deadline_s)
+        self.retries = max(1, int(retries))
+        self.backoff_s = float(backoff_s)
+        self.ttl_s = float(ttl_s)
+        self._breaker_kw = dict(
+            failure_threshold=max(1, int(breaker_fails)),
+            reset_timeout_s=float(breaker_reset_s),
+        )
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._rng = random.Random(seed)
+        # optional ``(resp, request) -> result`` hook: when a draining
+        # replica answers a dispatch with {"migrated": True, "snapshot"},
+        # the handler adopts the serialized decode state on another
+        # replica and returns its completion ({"ok": True, "tokens"}).
+        # Without one (or on adoption failure) the router replays from
+        # the prompt — migrate is an optimization, never a dependency.
+        self.migrate_handler = migrate_handler
+        self._clock = clock
+        self._sleep = sleep
+        self.ledger = ledger if ledger is not None else _slo.RequestLedger()
+        self._lock = threading.Lock()
+        self._router_s = 0.0       # host bookkeeping time (overhead gate)
+        self.outcomes: list[dict] = []
+        runtime_stats["deadline_s"] = self.deadline_s
+
+    # -- replica view ------------------------------------------------------
+
+    def breaker(self, replica_id: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(replica_id)
+            if br is None:
+                # the router's clock, so breaker reset timeouts advance
+                # with the same fake clock the deadline tests drive
+                br = self._breakers[replica_id] = CircuitBreaker(
+                    clock=self._clock, **self._breaker_kw
+                )
+            return br
+
+    def replicas(self, include_draining: bool = False) -> list[ReplicaInfo]:
+        """Live replicas: role records TTL-filtered, joined with each
+        replica's latest published gauges. A replica whose heartbeat aged
+        out is NOT listed — membership TTL expiry IS the loss detector."""
+        try:
+            records = self.store.replicas(alive_within_s=self.ttl_s)
+        except Exception:  # noqa: BLE001 — a torn store read routes around
+            return []
+        gauges: dict[str, dict] = {}
+        try:
+            for doc in self.store.read_metrics(alive_within_s=self.ttl_s):
+                rid = doc.get("replica_id")
+                if rid:
+                    gauges[str(rid)] = doc
+        except Exception:  # noqa: BLE001
+            pass
+        out = []
+        for rec in records:
+            rid = rec["replica_id"]
+            if rec.get("draining") and not include_draining:
+                continue
+            doc = gauges.get(rid, {})
+            g = doc.get("gauges") or {}
+            out.append(ReplicaInfo(
+                replica_id=rid,
+                host_id=rec.get("host_id", ""),
+                address=rec.get("address", ""),
+                draining=bool(rec.get("draining")),
+                queue_depth=float(g.get("serve_queue_depth", 0.0)),
+                kv_pages_free=float(g.get("serve_kv_pages_free", 0.0)),
+                slo_burn_rate=float(g.get("serve_slo_burn_rate", 0.0)),
+                t=float(doc.get("t", rec.get("last_heartbeat", 0.0))),
+                doc=rec,
+            ))
+        return out
+
+    def pick(self, exclude: set | None = None) -> ReplicaInfo | None:
+        """Power-of-two-choices by queue depth over admissible replicas
+        (alive, not draining, breaker allows; ``exclude`` drops replicas
+        this request already failed on this attempt round)."""
+        exclude = exclude or set()
+        cands = [
+            r for r in self.replicas()
+            if r.replica_id not in exclude
+            and self.breaker(r.replica_id).allow()
+        ]
+        if not cands:
+            return None
+        if len(cands) == 1:
+            return cands[0]
+        a, b = self._rng.sample(cands, 2)
+        # less loaded wins; at equal queue depth prefer the one with more
+        # KV headroom (pages are the resource admission actually blocks on)
+        if (a.queue_depth, -a.kv_pages_free) <= (b.queue_depth,
+                                                 -b.kv_pages_free):
+            return a
+        return b
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _backoff(self, attempt: int) -> float:
+        base = self.backoff_s * (2 ** attempt)
+        return base * (1.0 + 0.1 * self._rng.random())
+
+    def _terminal(self, rid, outcome: str, t0_mono: float, **detail):
+        rec = {
+            "rid": rid, "outcome": outcome,
+            "latency_s": self._clock() - t0_mono, **detail,
+        }
+        runtime_stats["inflight"].pop(rid, None)
+        runtime_stats[
+            "delivered" if outcome == "delivered" else outcome
+        ] = runtime_stats.get(
+            "delivered" if outcome == "delivered" else outcome, 0
+        ) + 1
+        self.outcomes.append(rec)
+        self._sync_gauges()
+        return rec
+
+    def _sync_gauges(self) -> None:
+        rolling_gauges.update({
+            "router_inflight": float(len(runtime_stats["inflight"])),
+            "router_dispatched": float(runtime_stats["dispatched"]),
+            "router_delivered": float(runtime_stats["delivered"]),
+            "router_failovers": float(runtime_stats["failovers"]),
+            "router_replayed": float(runtime_stats["replayed"]),
+            "router_shed": float(runtime_stats["shed"]),
+        })
+
+    def submit(self, request: dict) -> dict:
+        """Route one request to a terminal state — ALWAYS.
+
+        ``request`` is a plain dict (``{"rid", "prompt", "max_new_tokens"}``
+        plus anything the transport forwards). Returns the outcome record:
+        ``outcome`` ∈ {delivered, shed}, with ``tokens`` when delivered,
+        ``replays`` counting mid-flight failovers. This method never
+        raises for a replica's sake and never blocks past the deadline —
+        the never-hang contract lives here.
+        """
+        rid = request["rid"]
+        t0 = self._clock()
+        t0_pc = time.perf_counter()
+        self.ledger.begin(rid, t=t0_pc)
+        runtime_stats["inflight"][rid] = t0
+        self._sync_gauges()
+        deadline = t0 + self.deadline_s
+        attempts = 0
+        replays = 0
+        failed_on: set = set()
+        admitted = False
+        while True:
+            b0 = time.perf_counter()
+            remaining = deadline - self._clock()
+            if remaining <= 0 or attempts >= self.retries:
+                reason = (
+                    "deadline" if remaining <= 0 else "retry_budget"
+                )
+                self.ledger.add_phase(
+                    rid, "dispatch", b0, time.perf_counter(),
+                    attempts=attempts,
+                )
+                self.ledger.complete(rid, outcome=_slo.SHED)
+                _slo.runtime_stats["shed"] += 1
+                self._router_s += time.perf_counter() - b0
+                return self._terminal(
+                    rid, "shed", t0, reason=reason, replays=replays,
+                    attempts=attempts,
+                )
+            try:
+                fault_point("route.dispatch", rid=rid, attempt=attempts)
+                replica = self.pick(exclude=failed_on)
+            except InjectedFault:
+                replica = None
+            if replica is None and failed_on:
+                # every untried replica is gone/open — widen back out so a
+                # recovered breaker or a fresh registration can take it
+                failed_on = set()
+                replica = self.pick()
+            if replica is None:
+                attempts += 1
+                runtime_stats["retries"] += 1
+                delay = min(
+                    self._backoff(attempts - 1),
+                    max(0.0, deadline - self._clock()),
+                )
+                self._router_s += time.perf_counter() - b0
+                if delay > 0:
+                    self._sleep(delay)
+                continue
+            if not admitted:
+                self.ledger.note_admit(rid, t=time.perf_counter())
+                admitted = True
+            attempts += 1
+            runtime_stats["dispatched"] += 1
+            self._router_s += time.perf_counter() - b0
+            d0 = time.perf_counter()
+            try:
+                timeout = max(0.01, deadline - self._clock())
+                resp = self.transport(replica, request, timeout)
+                if isinstance(resp, dict) and resp.get("migrated"):
+                    # graceful drain answered mid-flight: the replica
+                    # serialized this request's decode state instead of
+                    # finishing it. Hand the snapshot to the migrate
+                    # handler; if adoption lands, the lifecycle closes
+                    # MIGRATED with the destination's tokens — otherwise
+                    # fall through to replay-from-prompt.
+                    mig = None
+                    if self.migrate_handler is not None:
+                        try:
+                            mig = self.migrate_handler(resp, request)
+                        except Exception:  # noqa: BLE001 — replay instead
+                            mig = None
+                    b1 = time.perf_counter()
+                    self.ledger.add_phase(
+                        rid, "dispatch", d0, b1,
+                        replica=replica.replica_id, attempt=attempts,
+                    )
+                    failed_on.add(replica.replica_id)
+                    if isinstance(mig, dict) and mig.get("ok"):
+                        self.ledger.add_phase(
+                            rid, "migrate", b1, time.perf_counter(),
+                            source=replica.replica_id,
+                        )
+                        self.ledger.complete(rid, outcome=_slo.MIGRATED)
+                        _instant(
+                            "fleet.migrate", rid=rid,
+                            source=replica.replica_id,
+                        )
+                        return self._terminal(
+                            rid, "migrated", t0, tokens=mig.get("tokens"),
+                            source=replica.replica_id,
+                            replays=replays, attempts=attempts,
+                        )
+                    replays += 1
+                    runtime_stats["replayed"] += 1
+                    continue
+                if not (isinstance(resp, dict) and resp.get("ok")):
+                    # refused (draining/overloaded), not dead: a
+                    # ConnectionError classifies as OUTAGE, so the
+                    # request retries on another replica
+                    raise ConnectionRefusedError(
+                        f"replica {replica.replica_id} refused: {resp!r}"
+                    )
+            except Exception as e:  # noqa: BLE001 — classified below
+                b1 = time.perf_counter()
+                self.ledger.add_phase(
+                    rid, "dispatch", d0, b1,
+                    replica=replica.replica_id, attempt=attempts,
+                    error=f"{type(e).__name__}"[:40],
+                )
+                self.breaker(replica.replica_id).record_failure()
+                failed_on.add(replica.replica_id)
+                kind = classify_exception(e)
+                runtime_stats["failovers"] += 1
+                _instant(
+                    "fleet.failover", rid=rid,
+                    replica=replica.replica_id,
+                    outage_class=kind.value,
+                    error=f"{type(e).__name__}: {e}"[:200],
+                )
+                if kind is OutageClass.DETERMINISTIC:
+                    # our bug, not the replica's weather: retrying the
+                    # same request elsewhere cannot help — shed now
+                    self.ledger.complete(rid, outcome=_slo.SHED)
+                    _slo.runtime_stats["shed"] += 1
+                    return self._terminal(
+                        rid, "shed", t0, reason="deterministic",
+                        replays=replays, attempts=attempts,
+                        error=f"{type(e).__name__}: {e}"[:200],
+                    )
+                replays += 1
+                runtime_stats["replayed"] += 1
+                delay = min(
+                    self._backoff(attempts - 1),
+                    max(0.0, deadline - self._clock()),
+                )
+                if delay > 0:
+                    self._sleep(delay)
+                continue
+            # delivered
+            b1 = time.perf_counter()
+            self.ledger.add_phase(
+                rid, "dispatch", d0, b1,
+                replica=replica.replica_id, attempt=attempts,
+            )
+            self.breaker(replica.replica_id).record_success()
+            self.ledger.complete(rid, outcome=_slo.DONE)
+            self._router_s += time.perf_counter() - b1
+            return self._terminal(
+                rid, "delivered", t0,
+                tokens=resp.get("tokens"),
+                replica=replica.replica_id,
+                replays=replays, attempts=attempts,
+            )
+
+    def note_migrated(self, rid, tokens=None, to_replica: str = "") -> dict:
+        """Close a lifecycle the fleet moved instead of replaying: the
+        drain path serialized its decode state and another replica now
+        owns it (``serve/fleet.py`` owns the KV wire; this is the
+        router-side terminal accounting)."""
+        if rid in self.ledger._open:
+            self.ledger.add_phase(
+                rid, "migrate",
+                time.perf_counter(), time.perf_counter(),
+                to=to_replica,
+            )
+            self.ledger.complete(rid, outcome=_slo.MIGRATED)
+        t0 = runtime_stats["inflight"].get(rid, self._clock())
+        return self._terminal(
+            rid, "migrated", t0, tokens=tokens, to=to_replica,
+        )
+
+    # -- health ------------------------------------------------------------
+
+    def lifecycles_closed(self) -> bool:
+        """True when every submitted request reached a terminal state —
+        the chaos drill's provably-closed assertion."""
+        return not self.ledger._open and not runtime_stats["inflight"]
+
+    def overhead_fraction(self, wall_s: float) -> float:
+        """Router host bookkeeping seconds / measured wall — the number
+        the bench prices under the existing 1% telemetry gate."""
+        return self._router_s / wall_s if wall_s > 0 else 0.0
+
+    def metrics(self) -> dict:
+        by = {}
+        for rec in self.outcomes:
+            by[rec["outcome"]] = by.get(rec["outcome"], 0) + 1
+        return {
+            "requests": len(self.outcomes),
+            "outcomes": by,
+            "failovers": runtime_stats["failovers"],
+            "replayed": runtime_stats["replayed"],
+            "lifecycles_closed": self.lifecycles_closed(),
+            "router_overhead_s": round(self._router_s, 6),
+        }
+
+
+class ScaleController:
+    """SLO-burn-driven elastic scaling over the replica fleet.
+
+    One control tick (:meth:`observe`) looks at the fleet's worst
+    published burn rate and decides one of three things:
+
+    - ``("scale_out", replica_id)`` — burn has exceeded ``burn_high`` for
+      enough consecutive ticks to satisfy the :class:`GrowGate` hysteresis
+      (K probes AND a minimum interval since the last fleet transition),
+      and a registered standby exists that the membership store does NOT
+      hold in quarantine. The caller starts/undrains that replica.
+    - ``("scale_in", replica_id)`` — burn has stayed below ``burn_low``
+      with idle queues for ``drain_probes`` consecutive ticks and more
+      than ``min_replicas`` replicas are active: the least-loaded replica
+      is returned for *graceful drain* (finish/migrate, then deregister —
+      never a kill).
+    - ``None`` — hold.
+
+    The gate's ``note_reshard`` fires on every decision, so scale-out and
+    scale-in share one hysteresis clock and cannot ping-pong.
+    """
+
+    def __init__(
+        self,
+        store,
+        *,
+        gate: GrowGate | None = None,
+        burn_high: float = 1.0,
+        burn_low: float = 0.25,
+        drain_probes: int = 3,
+        min_replicas: int = 1,
+        clock=time.monotonic,
+    ):
+        self.store = store
+        self.gate = gate if gate is not None else GrowGate(clock=clock)
+        self.burn_high = float(burn_high)
+        self.burn_low = float(burn_low)
+        self.drain_probes = max(1, int(drain_probes))
+        self.min_replicas = max(1, int(min_replicas))
+        self._idle_streak = 0
+
+    def _standby(self, active: list, standbys: list) -> str | None:
+        """First registered standby replica that is alive and whose HOST
+        the membership store does not hold in quarantine."""
+        active_ids = {r.replica_id for r in active}
+        for rec in standbys:
+            rid = rec.get("replica_id")
+            if rid in active_ids:
+                continue
+            host = rec.get("host_id")
+            try:
+                if host and self.store.is_quarantined(host_id=host):
+                    continue
+            except Exception:  # noqa: BLE001 — unreadable health = hold
+                continue
+            return rid
+        return None
+
+    def observe(
+        self, replicas: list, standbys: list | None = None,
+    ) -> tuple | None:
+        """One control tick over the router's current replica view.
+
+        ``replicas`` is ``FleetRouter.replicas()`` (active, serving);
+        ``standbys`` are replica role records registered with
+        ``standby=True`` (capacity that can be admitted).
+        """
+        if not replicas:
+            self.gate.veto()
+            return None
+        burn = max(r.slo_burn_rate for r in replicas)
+        queued = sum(r.queue_depth for r in replicas)
+        if burn > self.burn_high:
+            self._idle_streak = 0
+            # the GrowGate's capacity>world probe, reused verbatim: world
+            # is the active fleet, capacity is fleet + one admissible
+            # standby — K consecutive burning probes + min interval fire
+            target = self._standby(replicas, standbys or [])
+            cap = len(replicas) + (1 if target is not None else 0)
+            if self.gate.observe(cap, len(replicas)) and target:
+                self.gate.note_reshard()
+                return ("scale_out", target)
+            return None
+        self.gate.observe(len(replicas), len(replicas))  # resets streak
+        if burn < self.burn_low and queued == 0:
+            self._idle_streak += 1
+            if (
+                self._idle_streak >= self.drain_probes
+                and len(replicas) > self.min_replicas
+            ):
+                self._idle_streak = 0
+                victim = min(
+                    replicas,
+                    key=lambda r: (r.queue_depth, -r.kv_pages_free),
+                )
+                self.gate.note_reshard()
+                return ("scale_in", victim.replica_id)
+        else:
+            self._idle_streak = 0
+        return None

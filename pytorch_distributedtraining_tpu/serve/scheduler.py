@@ -44,9 +44,10 @@ import numpy as np
 from ..resilience.faults import InjectedFault, fault_point
 from .kv_cache import PagePool
 
-# request lifecycle states
-QUEUED, PREFILL, DECODE, DONE, DROPPED = (
-    "queued", "prefill", "decode", "done", "dropped",
+# request lifecycle states; MIGRATED retires a request whose decode
+# state was serialized out to another replica (serve/fleet.py drain)
+QUEUED, PREFILL, DECODE, DONE, DROPPED, MIGRATED = (
+    "queued", "prefill", "decode", "done", "dropped", "migrated",
 )
 
 
@@ -151,6 +152,7 @@ class AdmissionScheduler:
         self.free_slots: list[int] = list(range(n_slots))  # min-id first
         self.done: list[RequestState] = []
         self.dropped: list[Request] = []
+        self.migrated: list[RequestState] = []
         self._admit_order: deque[int] = deque()  # slots, admission order
 
     # -- submission / admission -------------------------------------------
@@ -183,15 +185,23 @@ class AdmissionScheduler:
             if need > self.pool.available:
                 break  # head-of-line blocks: FIFO stays FIFO
             self.queue.popleft()
+            # reserve FIRST (slot + worst-case pages), then let the
+            # admission controller decide — real admission control sheds
+            # *after* reservation (the reservation is what it is pricing),
+            # so a shed on this path must hand back every reserved
+            # resource or the pool leaks one request's pages per shed
+            slot = self.free_slots.pop(0)
+            pages = self.pool.alloc(need, req.rid)
             try:
                 fault_point("serve.admit", rid=req.rid)
             except InjectedFault:
+                self.pool.free(req.rid)  # shed-after-reservation: return
+                self.free_slots.append(slot)  # the pages AND the slot
+                self.free_slots.sort()
                 self.dropped.append(req)  # shed, never crash the engine
                 if self.ledger is not None:
                     self.ledger.shed(req.rid)  # terminal phase, closed
                 continue
-            slot = self.free_slots.pop(0)
-            pages = self.pool.alloc(need, req.rid)
             st = RequestState(req, slot, pages, admitted_s=now)
             self.active[slot] = st
             self._admit_order.append(slot)
@@ -225,7 +235,13 @@ class AdmissionScheduler:
 
     def retire(self, st: RequestState, now: float = 0.0,
                state: str = DONE) -> list[int]:
-        """Free the request's slot + pages; returns the freed page ids."""
+        """Free the request's slot + pages; returns the freed page ids.
+
+        Every terminal path funnels through here — DONE, DROPPED, and
+        MIGRATED all free the slot and the pool reservation, so the
+        pool invariant (owned + free == capacity, and ``pages_free``
+        back to initial once the engine is idle) holds by construction.
+        """
         st.state = state
         st.done_s = now
         del self.active[st.slot]
@@ -233,9 +249,12 @@ class AdmissionScheduler:
         freed = self.pool.free(st.rid)
         self.free_slots.append(st.slot)
         self.free_slots.sort()
-        (self.done if state == DONE else self.dropped).append(
-            st if state == DONE else st.req
-        )
+        if state == DONE:
+            self.done.append(st)
+        elif state == MIGRATED:
+            self.migrated.append(st)
+        else:
+            self.dropped.append(st.req)
         return freed
 
     # -- accounting --------------------------------------------------------

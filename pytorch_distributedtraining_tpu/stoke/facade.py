@@ -1828,6 +1828,58 @@ class Stoke:
 
         return build_engine(self._module, self._state.params, **overrides)
 
+    def serve_fleet(
+        self,
+        replicas: int | None = None,
+        standby: int = 0,
+        *,
+        started: bool = True,
+        route_knobs: dict | None = None,
+        **overrides,
+    ):
+        """Build a fault-tolerant serve fleet over the live params
+        (``serve/fleet.py``): N engines behind a membership-backed
+        :class:`~..serve.router.FleetRouter` with failover, graceful
+        drain/migration, and SLO-driven elastic scaling.
+
+        ``replicas`` defaults to ``GRAFT_SERVE_REPLICAS`` (2); each
+        replica gets its OWN engine built exactly like :meth:`serve`
+        (same ``GRAFT_SERVE_*`` knobs and ``overrides``, same snapshotted
+        params — so replay and KV migration land bitwise-identical
+        greedy tokens on any replica). ``standby`` engines register as
+        scale-out capacity the controller can admit when the SLO burn
+        rate runs hot. Router behavior comes from the ``GRAFT_ROUTE_*``
+        family (deadline, retries, backoff, TTL, breaker — see
+        ``docs/SERVING.md``), overridable via ``route_knobs``. Returns
+        the started :class:`~..serve.fleet.ServeFleet` (a context
+        manager; ``stop()`` or ``with`` tears it down).
+        """
+        self._require_state()
+        from ..serve import build_engine
+        from ..serve.fleet import ServeFleet
+
+        n = replicas if replicas is not None else int(
+            os.environ.get("GRAFT_SERVE_REPLICAS", "2") or 2
+        )
+        if n < 1:
+            raise ValueError(f"serve_fleet needs >=1 replica, got {n}")
+        engines = {
+            f"replica-{i}": build_engine(
+                self._module, self._state.params, **overrides
+            )
+            for i in range(n)
+        }
+        standbys = {
+            f"standby-{i}": build_engine(
+                self._module, self._state.params, **overrides
+            )
+            for i in range(max(0, int(standby)))
+        }
+        fleet = ServeFleet(
+            engines, standby=standbys or None, route_knobs=route_knobs,
+        )
+        return fleet.start() if started else fleet
+
     def export_trace(self, path: str | None = None) -> str | None:
         """Write recorded telemetry spans as Chrome trace-event JSON.
 

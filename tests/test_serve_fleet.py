@@ -1,0 +1,628 @@
+"""Fault-tolerant serve fleet: router semantics on a fake clock, the
+in-process fleet (failover, drain/migration), the KV-page migration wire
+format's bitwise-identity guarantee, SLO-driven elastic scale decisions,
+the ``router-hang`` / ``serve-replica-flap`` graftcheck rules, and the
+admission scheduler's shed-path pool invariant.
+
+The load-bearing contract under test is NEVER-HANG: every request the
+router admits reaches a terminal state (delivered / migrated / shed)
+inside the deadline, whatever the replicas do — including SIGKILL
+mid-decode and graceful drain. The lifecycle ledger closing
+(``lifecycles_closed``) is asserted everywhere because it is the proof,
+not a nicety.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributedtraining_tpu.analyze.findings import Severity
+from pytorch_distributedtraining_tpu.analyze.registry import (
+    AnalysisContext,
+    run_rules,
+)
+from pytorch_distributedtraining_tpu.models import GPT2, GPT2Config
+from pytorch_distributedtraining_tpu.resilience.faults import (
+    FaultPlan,
+    install_plan,
+)
+from pytorch_distributedtraining_tpu.runtime import (
+    membership as membership_mod,
+)
+from pytorch_distributedtraining_tpu.runtime.membership import (
+    GrowGate,
+    MembershipStore,
+    serve_store,
+)
+from pytorch_distributedtraining_tpu.serve import fleet as fleet_mod
+from pytorch_distributedtraining_tpu.serve import router as router_mod
+from pytorch_distributedtraining_tpu.serve.engine import ServeEngine
+from pytorch_distributedtraining_tpu.serve.fleet import (
+    EngineReplica,
+    FakeEngine,
+    ServeFleet,
+    read_migration,
+    split_migration,
+    tcp_transport,
+    write_migration,
+)
+from pytorch_distributedtraining_tpu.serve.router import (
+    FleetRouter,
+    ReplicaInfo,
+    ScaleController,
+    route_knobs_from_env,
+)
+from pytorch_distributedtraining_tpu.serve.scheduler import DECODE, Request
+
+CFG = GPT2Config.tiny(n_embd=32, n_head=4, n_positions=96)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = GPT2(CFG)
+    return model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def _engine(params, **kw):
+    base = dict(
+        n_slots=2, page_size=8, max_len=48, prefill_chunk=8,
+        prefill_buckets=(8,), temperature=0.0,
+    )
+    base.update(kw)
+    return ServeEngine(CFG, params, **base)
+
+
+class FakeClock:
+    """Deterministic clock + sleep pair for the router's injectables."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, s: float) -> None:
+        self.t += float(s)
+
+
+class StubStore:
+    """Minimal membership surface the router/controller read."""
+
+    def __init__(self):
+        self.records = []
+        self.metrics = []
+        self.quarantined = set()
+
+    def replicas(self, alive_within_s=None, include_standby=False):
+        return [dict(r) for r in self.records]
+
+    def read_metrics(self, alive_within_s=None):
+        return [dict(m) for m in self.metrics]
+
+    def is_quarantined(self, host_id=""):
+        return host_id in self.quarantined
+
+
+def _stub_store(*specs):
+    """specs: (replica_id, queue_depth, kv_pages_free) triples."""
+    st = StubStore()
+    for rid, q, kv in specs:
+        st.records.append({"replica_id": rid})
+        st.metrics.append({
+            "replica_id": rid, "t": 0.0,
+            "gauges": {
+                "serve_queue_depth": q, "serve_kv_pages_free": kv,
+            },
+        })
+    return st
+
+
+def _router(store, transport, clock=None, **knobs):
+    clock = clock or FakeClock()
+    kw = dict(
+        deadline_s=10.0, retries=3, backoff_s=0.01, ttl_s=60.0,
+        breaker_fails=3, breaker_reset_s=2.0,
+    )
+    kw.update(knobs)
+    router_mod.reset_runtime_stats()
+    return FleetRouter(
+        store, transport, clock=clock, sleep=clock.sleep, **kw
+    )
+
+
+class TestRouterUnits:
+    def test_p2c_never_picks_the_heaviest(self):
+        store = _stub_store(("a", 0.0, 9.0), ("b", 2.0, 9.0),
+                            ("c", 50.0, 9.0))
+        counts = {"a": 0, "b": 0, "c": 0}
+
+        def transport(replica, request, timeout_s):
+            counts[replica.replica_id] += 1
+            return {"ok": True, "tokens": [1]}
+
+        r = _router(store, transport)
+        for rid in range(40):
+            out = r.submit({"rid": rid, "prompt": [1], "max_new_tokens": 1})
+            assert out["outcome"] == "delivered"
+        # with 3 candidates p2c samples 2: the 50-deep replica loses every
+        # pairing it appears in, so it receives nothing
+        assert counts["c"] == 0
+        assert counts["a"] >= counts["b"] > 0
+        assert r.lifecycles_closed()
+
+    def test_deadline_expiry_sheds(self):
+        store = _stub_store(("a", 0.0, 1.0))
+        clock = FakeClock()
+
+        def transport(replica, request, timeout_s):
+            clock.t += 0.6  # each attempt burns wall, then dies
+            raise ConnectionResetError("replica went away")
+
+        r = _router(store, transport, clock=clock, deadline_s=2.0,
+                    retries=1000)
+        out = r.submit({"rid": 7, "prompt": [1], "max_new_tokens": 4})
+        assert out["outcome"] == "shed"
+        assert out["reason"] == "deadline"
+        assert out["replays"] > 0
+        assert router_mod.runtime_stats["inflight"] == {}
+        assert r.lifecycles_closed()
+
+    def test_retry_budget_sheds(self):
+        store = _stub_store(("a", 0.0, 1.0), ("b", 0.0, 1.0))
+        calls = []
+
+        def transport(replica, request, timeout_s):
+            calls.append(replica.replica_id)
+            raise ConnectionRefusedError("nope")
+
+        r = _router(store, transport, retries=2)
+        out = r.submit({"rid": 1, "prompt": [1], "max_new_tokens": 4})
+        assert out["outcome"] == "shed"
+        assert out["reason"] == "retry_budget"
+        assert len(calls) == 2 and out["attempts"] == 2
+        # the two attempts failed over between replicas, not hammered one
+        assert len(set(calls)) == 2
+        assert r.lifecycles_closed()
+
+    def test_breaker_opens_then_half_open_recovers(self):
+        store = _stub_store(("a", 0.0, 1.0))
+        clock = FakeClock()
+        healthy = {"flag": False}
+
+        def transport(replica, request, timeout_s):
+            if healthy["flag"]:
+                return {"ok": True, "tokens": [5]}
+            raise ConnectionResetError("down")
+
+        r = _router(store, transport, clock=clock, retries=1,
+                    breaker_fails=2, breaker_reset_s=5.0)
+        for rid in range(2):
+            assert r.submit(
+                {"rid": rid, "prompt": [1], "max_new_tokens": 1}
+            )["outcome"] == "shed"
+        # two consecutive failures: breaker OPEN, replica unroutable
+        assert not r.breaker("a").allow()
+        assert r.pick() is None
+        # past the reset timeout the breaker half-opens; one success closes
+        clock.t += 5.1
+        healthy["flag"] = True
+        out = r.submit({"rid": 9, "prompt": [1], "max_new_tokens": 1})
+        assert out["outcome"] == "delivered"
+        assert r.breaker("a").allow()
+        assert r.lifecycles_closed()
+
+    def test_migrated_response_closes_migrated(self):
+        store = _stub_store(("a", 0.0, 1.0))
+
+        def transport(replica, request, timeout_s):
+            return {"ok": False, "migrated": True,
+                    "snapshot": "/tmp/snap", "replica": "a"}
+
+        def handler(resp, request):
+            assert resp["snapshot"] == "/tmp/snap"
+            return {"ok": True, "tokens": [3, 1, 4]}
+
+        r = _router(store, transport)
+        r.migrate_handler = handler
+        out = r.submit({"rid": 2, "prompt": [1], "max_new_tokens": 3})
+        assert out["outcome"] == "migrated"
+        assert out["tokens"] == [3, 1, 4]
+        assert router_mod.runtime_stats["migrated"] == 1
+        assert r.lifecycles_closed()
+
+    def test_migrate_handler_failure_falls_back_to_replay(self):
+        store = _stub_store(("a", 0.0, 1.0))
+        n = {"calls": 0}
+
+        def transport(replica, request, timeout_s):
+            n["calls"] += 1
+            if n["calls"] == 1:
+                return {"ok": False, "migrated": True,
+                        "snapshot": "/tmp/snap", "replica": "a"}
+            return {"ok": True, "tokens": [8, 8]}
+
+        def handler(resp, request):
+            raise RuntimeError("adoption target died")
+
+        r = _router(store, transport)
+        r.migrate_handler = handler
+        out = r.submit({"rid": 3, "prompt": [1], "max_new_tokens": 2})
+        # migrate is an optimization, never a dependency: handler failure
+        # replays from the prompt on the widened candidate set
+        assert out["outcome"] == "delivered"
+        assert out["replays"] == 1
+        assert router_mod.runtime_stats["replayed"] == 1
+        assert r.lifecycles_closed()
+
+
+def _fake_tokens(prompt, n):
+    return [FakeEngine.token(prompt, i) for i in range(n)]
+
+
+class TestInProcessFleet:
+    def _fleet(self, tmp_path, n=2, tick_delay_s=0.0, **fleet_kw):
+        engines = {
+            f"r{i}": FakeEngine(tick_delay_s=tick_delay_s)
+            for i in range(n)
+        }
+        knobs = dict(deadline_s=15.0, retries=4, backoff_s=0.01,
+                     ttl_s=60.0)
+        return ServeFleet(
+            engines, root=str(tmp_path / "fleet"),
+            route_knobs=knobs, **fleet_kw,
+        )
+
+    def test_delivers_with_exact_tokens(self, tmp_path):
+        with self._fleet(tmp_path).start() as fleet:
+            for rid in range(6):
+                prompt = [rid + 1, rid + 2]
+                out = fleet.submit({
+                    "rid": rid, "prompt": prompt, "max_new_tokens": 5,
+                })
+                assert out["outcome"] == "delivered"
+                assert out["tokens"] == _fake_tokens(prompt, 5)
+            assert fleet.router.lifecycles_closed()
+
+    def test_kill_mid_decode_fails_over(self, tmp_path):
+        fleet = self._fleet(tmp_path, tick_delay_s=0.01).start()
+        try:
+            results = {}
+
+            def one(rid):
+                prompt = [rid + 1, 3]
+                results[rid] = (prompt, fleet.submit({
+                    "rid": rid, "prompt": prompt, "max_new_tokens": 20,
+                }))
+
+            ths = [
+                threading.Thread(target=one, args=(rid,), daemon=True)
+                for rid in range(8)
+            ]
+            for t in ths:
+                t.start()
+            time.sleep(0.08)  # let dispatches land on both replicas
+            fleet.kill("r0")
+            for t in ths:
+                t.join(timeout=20)
+            assert not any(t.is_alive() for t in ths)
+            assert len(results) == 8
+            for prompt, out in results.values():
+                # replay-from-prompt is deterministic: killed-replica
+                # requests land the SAME tokens from the survivor
+                assert out["outcome"] == "delivered"
+                assert out["tokens"] == _fake_tokens(prompt, 20)
+            assert fleet.router.metrics()["failovers"] >= 1
+            assert fleet.router.lifecycles_closed()
+        finally:
+            fleet.stop()
+
+    def test_drain_reaches_zero_then_deregisters(self, tmp_path):
+        store = MembershipStore(str(tmp_path / "members"), ttl_s=60.0)
+        drain_dir = str(tmp_path / "mig")
+        os.makedirs(drain_dir)
+        rep = EngineReplica(
+            FakeEngine(tick_delay_s=0.02), "r0", store=store,
+            drain_dir=drain_dir, heartbeat_s=0.05,
+        ).start()
+        try:
+            results = {}
+
+            def one(rid):
+                results[rid] = rep.submit(
+                    {"rid": rid, "prompt": [rid, 2], "max_new_tokens": 60},
+                    timeout_s=15.0,
+                )
+
+            ths = [
+                threading.Thread(target=one, args=(rid,), daemon=True)
+                for rid in range(2)
+            ]
+            for t in ths:
+                t.start()
+            time.sleep(0.3)  # both admitted and decoding
+            store.request_drain("r0", reason="test")
+            for t in ths:
+                t.join(timeout=15)
+            assert rep.drained.wait(5.0)
+            # every blocked dispatcher got the migration handoff, with a
+            # readable snapshot carrying the partial token streams
+            for rid, res in results.items():
+                assert res["migrated"] is True and res["snapshot"]
+            snap = read_migration(results[0]["snapshot"])
+            by_rid = {m["rid"]: m for m in snap["requests"]}
+            assert set(by_rid) == {0, 1}
+            for rid, meta in by_rid.items():
+                got = meta["tokens"]
+                assert 0 < len(got) < 60  # genuinely mid-decode
+                assert got == _fake_tokens(meta["prompt"], len(got))
+            # drained to zero BEFORE deregistering: nothing resident, and
+            # the role record is gone from the store
+            assert rep.engine.active == {} and rep.engine.queue == []
+            assert store.replicas() == []
+        finally:
+            rep.stop()
+
+
+class TestTCPFleetFailover:
+    """Two replica subprocesses behind a TCP membership store: the
+    cross-process version of the kill test — SIGKILL resets real
+    sockets, membership TTL ages the corpse out, the router replays."""
+
+    def _spawn(self, store_addr, rid, tmp_path):
+        env = dict(
+            os.environ,
+            GRAFT_FLEET_STORE=store_addr,
+            GRAFT_FLEET_REPLICA_ID=rid,
+            GRAFT_FLEET_FAKE="1",
+            GRAFT_FLEET_TICK_DELAY_S="0.02",
+            GRAFT_FLEET_DRAIN_DIR=str(tmp_path),
+            JAX_PLATFORMS="cpu",
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "pytorch_distributedtraining_tpu.serve.fleet"],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True,
+        )
+        line = proc.stdout.readline()
+        info = json.loads(line)
+        assert info["event"] == "replica_up", info
+        return proc, info
+
+    def test_sigkill_failover_end_to_end(self, tmp_path):
+        store = MembershipStore(str(tmp_path / "members"), ttl_s=60.0)
+        server, _ = serve_store(store)
+        addr = "tcp://%s:%d" % server.server_address[:2]
+        procs = []
+        try:
+            for i in range(2):
+                procs.append(self._spawn(addr, f"tcp-r{i}", tmp_path))
+            router_mod.reset_runtime_stats()
+            router = FleetRouter(
+                store, tcp_transport, deadline_s=20.0, retries=4,
+                backoff_s=0.02, ttl_s=2.0,
+            )
+            deadline = time.monotonic() + 10
+            while len(router.replicas()) < 2:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            results = {}
+
+            def one(rid):
+                prompt = [rid + 1, 5]
+                results[rid] = (prompt, router.submit({
+                    "rid": rid, "prompt": prompt, "max_new_tokens": 25,
+                }))
+
+            ths = [
+                threading.Thread(target=one, args=(rid,), daemon=True)
+                for rid in range(6)
+            ]
+            for t in ths:
+                t.start()
+            time.sleep(0.15)
+            procs[0][0].kill()  # real SIGKILL: sockets reset, no goodbye
+            for t in ths:
+                t.join(timeout=25)
+            assert not any(t.is_alive() for t in ths)
+            for prompt, out in results.values():
+                assert out["outcome"] == "delivered"
+                assert out["tokens"] == _fake_tokens(prompt, 25)
+            assert router_mod.runtime_stats["failovers"] >= 1
+            assert router.lifecycles_closed()
+        finally:
+            for proc, _ in procs:
+                if proc.poll() is None:
+                    proc.kill()
+            server.shutdown()
+
+
+class TestKVMigrationBitwise:
+    def test_migrated_decode_matches_uninterrupted(self, params, tmp_path):
+        prompt = [11, 7, 5, 3]
+        n_new = 12
+        # the reference: one engine decodes uninterrupted
+        ref_eng = _engine(params)
+        ref = ref_eng.run(
+            [Request(0, list(prompt), n_new)], realtime=False
+        )[0]["tokens"]
+        assert len(ref) == n_new
+
+        # source decodes partway, exports; destination adopts, finishes
+        src, dst = _engine(params), _engine(params)
+        src.submit(Request(0, list(prompt), n_new))
+        now = 0.0
+        while True:
+            src.tick(now)
+            now += 0.01
+            st = next(iter(src.sched.active.values()), None)
+            if st is not None and st.state == DECODE and len(st.tokens) >= 4:
+                break
+        snap, leftover = src.migrate_out()
+        assert leftover == []
+        assert src.pool.in_use == 0  # source freed every page on export
+        src.pool.check_invariants()
+        path = write_migration(snap, str(tmp_path / "mig"))
+        loaded = read_migration(path, engine=dst)
+        adopted = dst.adopt(split_migration(loaded, 0))
+        assert adopted == [0]
+        while dst.sched.active or dst.sched.queue:
+            dst.tick(now)
+            now += 0.01
+        rec = next(r for r in dst.delivered if r["rid"] == 0)
+        # THE guarantee: migrated KV pages + greedy decode = bitwise the
+        # same continuation an uninterrupted run produces
+        assert rec["tokens"] == ref
+        assert dst.pool.in_use == 0
+        dst.pool.check_invariants()
+
+
+class TestScaleController:
+    def _replicas(self, *specs):
+        return [
+            ReplicaInfo(replica_id=rid, host_id=f"h-{rid}",
+                        queue_depth=q, kv_pages_free=kv,
+                        slo_burn_rate=burn)
+            for rid, burn, q, kv in specs
+        ]
+
+    def test_scale_out_respects_hysteresis_and_quarantine(self):
+        store = StubStore()
+        clock = FakeClock()
+        gate = GrowGate(probes_needed=3, min_interval_s=0.0, clock=clock)
+        ctrl = ScaleController(store, gate=gate, clock=clock)
+        burning = self._replicas(("r0", 2.0, 4.0, 1.0))
+        standbys = [{"replica_id": "s0", "host_id": "h-s0"}]
+        # K-probe hysteresis: two burning ticks hold, the third fires
+        assert ctrl.observe(burning, standbys) is None
+        assert ctrl.observe(burning, standbys) is None
+        assert ctrl.observe(burning, standbys) == ("scale_out", "s0")
+        # a quarantined standby host is never admitted, however hot
+        store.quarantined.add("h-s0")
+        gate2 = GrowGate(probes_needed=1, min_interval_s=0.0, clock=clock)
+        ctrl2 = ScaleController(store, gate=gate2, clock=clock)
+        for _ in range(5):
+            assert ctrl2.observe(burning, standbys) is None
+
+    def test_scale_in_needs_sustained_headroom(self):
+        clock = FakeClock()
+        ctrl = ScaleController(
+            StubStore(), gate=GrowGate(clock=clock), drain_probes=2,
+            min_replicas=1, clock=clock,
+        )
+        idle = self._replicas(
+            ("r0", 0.0, 0.0, 2.0), ("r1", 0.0, 0.0, 8.0)
+        )
+        assert ctrl.observe(idle) is None  # one idle tick is a blip
+        # the least-loaded replica (more free pages at equal queue) drains
+        assert ctrl.observe(idle) == ("scale_in", "r1")
+        # min_replicas floors it: a 1-replica fleet never drains itself
+        solo = self._replicas(("r0", 0.0, 0.0, 2.0))
+        for _ in range(5):
+            assert ctrl.observe(solo) is None
+
+
+class TestFleetRules:
+    def _run(self):
+        return run_rules(AnalysisContext(), planes=("runtime",))
+
+    def test_router_hang_fires_past_deadline(self):
+        router_mod.reset_runtime_stats()
+        try:
+            router_mod.runtime_stats["deadline_s"] = 0.5
+            router_mod.runtime_stats["inflight"] = {
+                "stuck-1": time.monotonic() - 5.0,
+            }
+            f = next(
+                f for f in self._run().findings if f.rule == "router-hang"
+            )
+            assert f.severity is Severity.ERROR
+            assert "stuck-1" in f.evidence
+        finally:
+            router_mod.reset_runtime_stats()
+
+    def test_router_hang_quiet_inside_deadline(self):
+        router_mod.reset_runtime_stats()
+        try:
+            router_mod.runtime_stats["deadline_s"] = 30.0
+            router_mod.runtime_stats["inflight"] = {
+                "fresh": time.monotonic(),
+            }
+            assert "router-hang" not in [
+                f.rule for f in self._run().findings
+            ]
+        finally:
+            router_mod.reset_runtime_stats()
+
+    def test_replica_flap_warns_on_churn(self, monkeypatch):
+        monkeypatch.setenv("GRAFT_FLAP_MAX", "3")
+        membership_mod.reset_runtime_stats()
+        try:
+            t0 = time.monotonic()
+            membership_mod.runtime_stats["hysteresis_window_s"] = 30.0
+            membership_mod.runtime_stats["replica_events"] = [
+                (t0 + i * 0.5, "churny",
+                 "register" if i % 2 == 0 else "deregister")
+                for i in range(10)  # 5 cycles inside one window
+            ]
+            f = next(
+                f for f in self._run().findings
+                if f.rule == "serve-replica-flap"
+            )
+            assert f.severity is Severity.WARN
+            assert "churny" in f.evidence and "cycles=5" in f.evidence
+        finally:
+            membership_mod.reset_runtime_stats()
+
+    def test_replica_flap_quiet_when_spread_out(self, monkeypatch):
+        monkeypatch.setenv("GRAFT_FLAP_MAX", "3")
+        membership_mod.reset_runtime_stats()
+        try:
+            t0 = time.monotonic()
+            membership_mod.runtime_stats["hysteresis_window_s"] = 30.0
+            membership_mod.runtime_stats["replica_events"] = [
+                (t0 + i * 100.0, "steady",
+                 "register" if i % 2 == 0 else "deregister")
+                for i in range(10)  # same churn, hours apart
+            ]
+            assert "serve-replica-flap" not in [
+                f.rule for f in self._run().findings
+            ]
+        finally:
+            membership_mod.reset_runtime_stats()
+
+
+class TestShedPathPoolInvariant:
+    def test_shed_returns_pages_and_slot(self, params):
+        """Regression: shedding at the admission fault site must return
+        BOTH the reserved pages and the slot — a leak here starves the
+        pool one shed at a time until admission wedges."""
+        install_plan(FaultPlan.from_json([
+            {"site": "serve.admit", "action": "raise", "at": 1,
+             "times": 2},
+        ]))
+        try:
+            eng = _engine(params)
+            free0 = eng.pool.available
+            reqs = [Request(i, [3 + i, 5, 7], 3) for i in range(5)]
+            records = eng.run(reqs, realtime=False)
+        finally:
+            install_plan(None)
+        assert len(records) == 3
+        assert len(eng.sched.dropped) == 2
+        # every terminal path funnelled through retire/shed: the pool is
+        # back to its starting free count and all slots are home
+        assert eng.pool.in_use == 0
+        assert eng.pool.available == free0
+        eng.pool.check_invariants()
+        assert eng.sched.free_slots == list(range(eng.sched.n_slots))
