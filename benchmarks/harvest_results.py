@@ -48,6 +48,12 @@ STAGES = [
              "grow back to 2 with a bitwise reshard check — "
              "time_to_grow_s (bench.py, GRAFT_BENCH_RECOVERY=1 "
              "GRAFT_BENCH_RECOVERY_GROW=1)"),
+    ("serve_spec", "decode fast path: self-speculative + quantized-KV "
+                   "arms vs vanilla on the same Poisson trace — spec_k, "
+                   "accept_rate, decode_tokens_per_sec_spec, kv_wire, "
+                   "kv_bytes_per_slot, slots_per_hbm_gain "
+                   "(serve_bench.py, GRAFT_SERVE_SPEC_K + "
+                   "GRAFT_SERVE_KV_WIRE)"),
     ("serve_fleet", "serve-fleet failover drill: time_to_failover_s, "
                     "terminal-state census (migrated/replayed/shed) and "
                     "router overhead under SIGKILL + graceful drain "
@@ -126,6 +132,8 @@ ARM_KNOBS = {
     "grow": "GRAFT_BENCH_RECOVERY=1 GRAFT_BENCH_RECOVERY_GROW=1",
     # serving SLO arm (summary record; continuous-vs-static lives inside)
     "serve": "GRAFT_BENCH_SERVE=1",
+    # decode fast-path arms (same serve_bench record, spec/kvq arms on)
+    "serve_spec": "GRAFT_SERVE_SPEC_K=4 GRAFT_SERVE_KV_WIRE=int8_block",
     # fleet failover arm (robustness record, never a throughput winner)
     "serve_fleet": "GRAFT_BENCH_SERVE_FLEET=1",
     # numerics plane arm (health record, never a throughput winner)

@@ -67,8 +67,12 @@ def main(argv=None) -> int:
                     help="regression (ERROR) threshold (default 0.15)")
     opt = ap.parse_args(argv)
 
-    fresh = _load_fresh(opt.record, opt.root)
-    history = fleet.load_trajectory(opt.root)
+    # summary records (e.g. serve_bench's serve_slo line) are trended by
+    # their headline metric — decode_tokens_per_sec_spec — on BOTH sides
+    fresh = fleet.headline_record(_load_fresh(opt.record, opt.root))
+    history = [
+        fleet.headline_record(r) for r in fleet.load_trajectory(opt.root)
+    ]
     if opt.record is None and history:
         # the implicit fresh record is history's tail; don't let a value
         # vote for its own baseline

@@ -30,16 +30,38 @@ gated at the same 1% publication bar as bench.py's span probe (exit 9
 over it). The continuous arm's lifecycles are exported as a
 ``graft-serve`` Chrome-trace lane for ``trace_summary.py``.
 
+Two decode fast-path arms ride the same trace (docs/SERVING.md):
+
+- **spec** — self-speculative decoding (``spec_k`` drafts per tick, one
+  batched verify). Greedy decode is deterministic, so the arm's tokens
+  must be **identical** per request to the continuous arm's
+  (``spec_token_identical``) — a speedup that changes tokens is a bug,
+  not a speedup — and its realized ``accept_rate`` is published next to
+  the ``decode_tokens_per_sec_spec`` headline.
+- **kvq** — block-scaled quantized paged KV residency
+  (``GRAFT_SERVE_KV_WIRE``, default int8_block for the bench): the
+  engine's ``kv_bytes_per_slot`` pricing must show >= 1.8x resident
+  slots per HBM byte vs dense, gated by per-request token agreement
+  with the dense continuous arm (``kv_gate_green``).
+
 One JSON line:
     {"metric": "serve_slo", "continuous": {p50/p99 latency + TTFT,
      tokens/sec, occupancy, steady_recompiles, phase_breakdown_s,
-     tail_attribution, slo}, "static": {...}, "slo_burn_rate": ...,
-     "telemetry_overhead_fraction": ...,
+     tail_attribution, slo}, "static": {...}, "spec": {...,
+     spec_k, accept_rate, decode_tokens_per_sec}, "kvq": {...,
+     kv_wire, kv_bytes_per_slot, slots_per_hbm_gain},
+     "spec_k": ..., "accept_rate": ..., "kv_wire": ...,
+     "kv_bytes_per_slot": ..., "decode_tokens_per_sec_spec": ...,
+     "spec_token_identical": bool, "kv_gate_green": bool,
+     "slo_burn_rate": ..., "telemetry_overhead_fraction": ...,
      "continuous_beats_static": bool, "graftcheck_clean": bool, ...}
 
 Env: GRAFT_BENCH_PLATFORM=cpu -> tiny-model CPU self-test;
 GRAFT_SERVE_BENCH_REQUESTS / GRAFT_SERVE_BENCH_GAP_MS resize the trace;
-the engine's own GRAFT_SERVE_* / GRAFT_SERVE_SLO_* knobs apply on top.
+GRAFT_SERVE_SPEC_K / GRAFT_SERVE_KV_WIRE pick the fast-path arms' knobs
+(bench defaults 4 / int8_block when unset — the vanilla arms always run
+with the fast path off, so the A/B stays honest); the engine's other
+GRAFT_SERVE_* / GRAFT_SERVE_SLO_* knobs apply to every arm.
 """
 
 from __future__ import annotations
@@ -132,7 +154,26 @@ def _arm(cfg, params, trace, admission, knobs, realtime):
         "phase_p99_s": slo_mod.phase_quantiles(completed, 99),
         "tail_attribution": slo_mod.tail_attribution(completed),
         "slo": m["slo"],
+        # decode fast-path accounting (zeros/None when the path is off)
+        "decode_tokens_per_sec": round(m["decode_tokens_per_sec"], 2),
+        "spec_k": m["spec"]["spec_k"],
+        "accept_rate": round(m["spec"]["accept_rate"], 4),
+        "kv_wire": m["kv"]["kv_wire"],
+        "kv_bytes_per_slot": m["kv"]["kv_bytes_per_slot"],
+        "slots_per_hbm_gain": round(m["kv"]["slots_per_hbm_gain"], 4),
     }, eng
+
+
+def _tokens_by_rid(eng) -> dict:
+    return {r["rid"]: list(r["tokens"]) for r in eng.delivered}
+
+
+def _token_agreement(a: dict, b: dict) -> float:
+    """Fraction of requests whose full token sequences agree."""
+    rids = set(a) & set(b)
+    if not rids:
+        return 0.0
+    return sum(1 for r in rids if a[r] == b[r]) / len(rids)
 
 
 def _ledger_overhead_fraction(eng, wall_s: float) -> float:
@@ -234,8 +275,12 @@ def run_serve_bench(*, realtime: bool = True) -> dict:
 
     telemetry.enable()
     if CPU_SELF_TEST:
+        # n_embd=64 keeps the model tiny while making the quantized-KV
+        # residency ratio representative: at head_dim*n_head < 64 the
+        # per-position f32 scale dominates and the >=1.8x gain bar is
+        # unreachable regardless of format quality
         cfg = GPT2Config(
-            vocab_size=64, n_positions=96, n_embd=32, n_layer=2, n_head=2,
+            vocab_size=64, n_positions=96, n_embd=64, n_layer=2, n_head=2,
         )
     else:  # GPT-2 125M, bf16 — the BASELINE ladder's transformer
         cfg = GPT2Config(dtype=jnp.bfloat16)
@@ -248,6 +293,10 @@ def run_serve_bench(*, realtime: bool = True) -> dict:
     if CPU_SELF_TEST:
         knobs.update(n_slots=3, page_size=8, max_len=48,
                      prefill_chunk=16, prefill_buckets=(8, 16))
+    # fast-path knobs go ONLY to their own arms: the vanilla arms run
+    # with spec/quantization off so the A/B comparison stays honest
+    spec_k = knobs.pop("spec_k", 0) or 4
+    kv_wire = knobs.pop("kv_wire", None) or "int8_block"
     rng = np.random.default_rng(0)
     trace_reqs = build_trace(
         rng, N_REQUESTS,
@@ -265,6 +314,24 @@ def run_serve_bench(*, realtime: bool = True) -> dict:
         cfg, params, trace_reqs, "continuous", knobs, realtime
     )
     static, _ = _arm(cfg, params, trace_reqs, "static", knobs, realtime)
+    spec, s_eng = _arm(
+        cfg, params, trace_reqs, "continuous",
+        dict(knobs, spec_k=spec_k), realtime,
+    )
+    kvq, q_eng = _arm(
+        cfg, params, trace_reqs, "continuous",
+        dict(knobs, kv_wire=kv_wire), realtime,
+    )
+    # greedy decode is deterministic: the speculative arm must bank the
+    # EXACT tokens the vanilla arm did, request by request
+    base_toks = _tokens_by_rid(c_eng)
+    spec_token_identical = _token_agreement(base_toks, _tokens_by_rid(s_eng)) == 1.0
+    # quantized residency gate: block-scaled rounding may flip an argmax
+    # in principle, so the gate is near-unanimous token agreement with
+    # the dense arm (the strict paged==dense tolerance matrix lives in
+    # tests/test_serve_spec.py)
+    kv_agreement = _token_agreement(base_toks, _tokens_by_rid(q_eng))
+    kv_gate_green = kv_agreement >= 0.95
     chaos = _chaos(cfg, params, knobs)
     overhead = _ledger_overhead_fraction(c_eng, continuous["wall_s"])
     serve_trace_path = c_eng.export_serve_trace()
@@ -284,6 +351,7 @@ def run_serve_bench(*, realtime: bool = True) -> dict:
         f for f in findings
         if f["rule"] == "serve-recompile-under-load"
         or (f["rule"] == "serve-slo-burn" and f["severity"] == "ERROR")
+        or (f["rule"] == "serve-spec-regress" and f["severity"] == "ERROR")
     ]
 
     ledger = GoodputLedger.from_tracer(
@@ -301,8 +369,23 @@ def run_serve_bench(*, realtime: bool = True) -> dict:
         "mean_gap_ms": GAP_MS,
         "continuous": continuous,
         "static": static,
+        "spec": spec,
+        "kvq": kvq,
         "continuous_beats_static": beats,
+        # decode fast-path headlines (harvest_results.py serve_spec stage)
+        "spec_k": spec["spec_k"],
+        "accept_rate": spec["accept_rate"],
+        "decode_tokens_per_sec_spec": spec["decode_tokens_per_sec"],
+        "decode_tokens_per_sec_vanilla": continuous["decode_tokens_per_sec"],
+        "spec_token_identical": spec_token_identical,
+        "kv_wire": kvq["kv_wire"],
+        "kv_bytes_per_slot": kvq["kv_bytes_per_slot"],
+        "slots_per_hbm_gain": kvq["slots_per_hbm_gain"],
+        "kv_token_agreement": round(kv_agreement, 4),
+        "kv_gate_green": kv_gate_green,
         "steady_recompiles": continuous["steady_recompiles"],
+        "steady_recompiles_spec": spec["steady_recompiles"],
+        "steady_recompiles_kvq": kvq["steady_recompiles"],
         "slo_burn_rate": continuous["slo"]["burn_rate"],
         "tail_attribution": continuous["tail_attribution"],
         "telemetry_overhead_fraction": round(overhead, 6),
@@ -330,7 +413,40 @@ def main() -> None:
         "serving engine recompiled during the steady-state window: "
         f"{record['graftcheck_findings']}"
     )
+    assert record["steady_recompiles_spec"] == 0, (
+        "speculative arm recompiled in steady state — the fast path's "
+        "one extra program must be warmed before mark_steady: "
+        f"{record['graftcheck_findings']}"
+    )
+    assert record["steady_recompiles_kvq"] == 0, (
+        "quantized-KV arm recompiled in steady state: "
+        f"{record['graftcheck_findings']}"
+    )
     assert record["graftcheck_clean"], record["graftcheck_findings"]
+    # the fast-path claims: spec must be a pure speedup (identical
+    # tokens, more of them per decode second) and quantized residency
+    # must actually buy slots per HBM byte without breaking tokens
+    assert record["spec_token_identical"], (
+        "speculative arm diverged from vanilla greedy decode — the "
+        "accept rule must make accepted tokens exactly the greedy ones"
+    )
+    assert (
+        record["decode_tokens_per_sec_spec"]
+        > record["decode_tokens_per_sec_vanilla"]
+    ), (
+        f"speculative decode did not beat vanilla: "
+        f"{record['decode_tokens_per_sec_spec']} <= "
+        f"{record['decode_tokens_per_sec_vanilla']} tok/s "
+        f"(accept_rate={record['accept_rate']})"
+    )
+    assert record["slots_per_hbm_gain"] >= 1.8, (
+        f"quantized KV residency gain {record['slots_per_hbm_gain']}x "
+        "is below the 1.8x bar"
+    )
+    assert record["kv_gate_green"], (
+        f"quantized-KV token agreement {record['kv_token_agreement']} "
+        "below gate — residency format is changing what gets decoded"
+    )
     # the tail attribution is the point of the lifecycle plumbing: an
     # empty one means no request completed its phase accounting
     assert record["tail_attribution"].get("dominant_phase"), (

@@ -202,6 +202,69 @@ def serve_recompile_under_load(ctx):
 
 
 @rule(
+    "serve-spec-regress",
+    "runtime",
+    "speculative decode regressing: low accept rate or steady-set growth",
+)
+def serve_spec_regress(ctx):
+    # sys.modules, never imported: the engine pulls in jax and this plane
+    # must stay importable from jax-free tooling
+    eng = sys.modules.get("pytorch_distributedtraining_tpu.serve.engine")
+    stats = getattr(eng, "runtime_stats", None)
+    if not stats or not stats.get("spec_enabled"):
+        return
+    grew = stats.get("steady_recompiles", 0)
+    if stats.get("steady_windows") and grew > 0:
+        yield Finding(
+            "serve-spec-regress",
+            Severity.ERROR,
+            "runtime:serve",
+            f"speculative decode grew the steady compiled set by {grew} "
+            "program(s): the fast path's contract is exactly ONE extra "
+            "program (the [n_slots, k] verify step), warmed before "
+            "mark_steady — anything beyond that means a spec shape "
+            "escaped warmup and the latency win is being paid back as "
+            "trace+compile on the serving path. Pin GRAFT_SERVE_SPEC_K "
+            "so warmup and steady state agree on the draft depth",
+            evidence=(
+                f"spec_k={stats.get('spec_k')} "
+                f"jit_entries_at_steady={stats.get('jit_entries_at_steady')} "
+                f"jit_entries_now={stats.get('jit_entries_now')} "
+                f"steady_recompiles={grew}"
+            ),
+        )
+    if not stats.get("spec_ticks"):
+        return
+    raw = (os.environ.get("GRAFT_SPEC_ACCEPT_FLOOR") or "").strip()
+    try:
+        floor = float(raw) if raw else 0.0
+    except ValueError:
+        floor = 0.0
+    rate = float(stats.get("spec_accept_rate", 1.0))
+    if floor > 0.0 and rate < floor:
+        yield Finding(
+            "serve-spec-regress",
+            Severity.WARN,
+            "runtime:serve",
+            f"speculative accept rate {rate:.3f} is below the provisioned "
+            f"floor {floor:.3f}: each decode tick is verifying spec_k "
+            "positions but banking barely more than the one guaranteed "
+            "greedy token, so the verify pass's extra FLOPs/HBM traffic "
+            "are overhead, not speedup. Lower GRAFT_SERVE_SPEC_K (shorter "
+            "drafts fail cheaper) or disable the fast path for this "
+            "workload — prompt-lookup drafting only pays off on "
+            "repetitive continuations",
+            evidence=(
+                f"spec_k={stats.get('spec_k')} "
+                f"spec_ticks={stats.get('spec_ticks')} "
+                f"spec_proposed={stats.get('spec_proposed')} "
+                f"spec_accepted={stats.get('spec_accepted')} "
+                f"spec_accept_rate={rate:.4f} floor={floor}"
+            ),
+        )
+
+
+@rule(
     "serve-slo-burn",
     "runtime",
     "serving error budget burning faster than provisioned",

@@ -106,6 +106,54 @@ def init_cache(model, batch_size: int, max_len: int):
 # -- paged KV layout ---------------------------------------------------------
 
 
+def kv_scale_block(fmt, n_head: int, head_dim: int) -> int:
+    """Effective scale-block for one position's ``[H*Dh]`` feature vector.
+
+    Page quantization scales along the feature dim of each (page, offset)
+    position. The wire format's block is honored when it divides ``H*Dh``;
+    otherwise the whole per-position vector shares one scale (small models
+    whose head dims do not reach DEFAULT_BLOCK degrade to per-position
+    scaling, never to padding).
+    """
+    n = n_head * head_dim
+    blk = fmt.block or n
+    return blk if n % blk == 0 else n
+
+
+def quantize_kv(x, fmt, block: int):
+    """``[..., H, Dh]`` K/V -> (payload ``[..., H, Dh]`` narrow dtype,
+    scales ``[..., (H*Dh)//block]`` f32).
+
+    Same math as ``parallel.compressed.WireFormat.encode`` (absmax per
+    block, round/clip for int payloads, cast for fp8), restated on the
+    page layout so the scatter indexing of :func:`write_paged_kv` applies
+    to payload and scales alike.
+    """
+    from ..parallel.compressed import SCALE_EPS
+
+    h, dh = x.shape[-2], x.shape[-1]
+    lead = x.shape[:-2]
+    xf = x.astype(jnp.float32).reshape(*lead, (h * dh) // block, block)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scales = jnp.maximum(amax / fmt.qmax, SCALE_EPS)
+    y = xf / scales[..., None]
+    if jnp.issubdtype(jnp.dtype(fmt.payload_dtype), jnp.integer):
+        y = jnp.round(y)
+    y = jnp.clip(y, -fmt.qmax, fmt.qmax).astype(fmt.payload_dtype)
+    return y.reshape(*lead, h, dh), scales
+
+
+def dequantize_kv(payload, scales, dtype):
+    """Inverse of :func:`quantize_kv`; block size is implied by shapes."""
+    h, dh = payload.shape[-2], payload.shape[-1]
+    lead = payload.shape[:-2]
+    s = scales.shape[-1]
+    block = (h * dh) // s
+    y = payload.astype(jnp.float32).reshape(*lead, s, block)
+    y = y * scales[..., None]
+    return y.reshape(*lead, h, dh).astype(dtype)
+
+
 def write_paged_kv(k_pages, v_pages, k, v, page_table, lengths):
     """Scatter a chunk's K/V into the page pool at each slot's position.
 
@@ -117,6 +165,10 @@ def write_paged_kv(k_pages, v_pages, k, v, page_table, lengths):
     Positions past a slot's allocated pages resolve to the null page
     (page-table rows are 0-padded), so bucket padding can never corrupt
     another slot's KV. Returns the updated ``(k_pages, v_pages)``.
+
+    The scatter is shape-generic past the (page, offset) axes — the same
+    indexing writes quantized payload pages ``[…, H, Dh]`` and their scale
+    pages ``[…, S]`` (quantized KV reuses this function for both).
     """
     page = k_pages.shape[1]
     t = k.shape[1]
@@ -128,7 +180,8 @@ def write_paged_kv(k_pages, v_pages, k, v, page_table, lengths):
 
 
 def paged_attention(q, k_pages, v_pages, page_table, lengths,
-                    softmax_dtype=jnp.float32):
+                    softmax_dtype=jnp.float32, *,
+                    k_scales=None, v_scales=None):
     """Causal attention of ``q`` against each slot's gathered pages.
 
     ``q``: ``[B, T, H, Dh]`` queries at global positions
@@ -138,12 +191,22 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths,
     slot's live length are masked (never-written) or garbage that the
     write-before-read invariant guarantees is overwritten before any real
     query reaches it.
+
+    With ``k_scales``/``v_scales`` (``[num_pages, page, S]``) the pools
+    hold block-quantized payloads (:func:`quantize_kv`); the gathered view
+    is dequantized to ``q.dtype`` before the attention matmuls — the
+    quantized-KV read path.
     """
     b, t, h, dh = q.shape
     page = k_pages.shape[1]
     max_len = page_table.shape[1] * page
     gk = k_pages[page_table].reshape(b, max_len, h, dh)
     gv = v_pages[page_table].reshape(b, max_len, h, dh)
+    if k_scales is not None:
+        sk = k_scales[page_table].reshape(b, max_len, -1)
+        sv = v_scales[page_table].reshape(b, max_len, -1)
+        gk = dequantize_kv(gk, sk, q.dtype)
+        gv = dequantize_kv(gv, sv, q.dtype)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, gk) / jnp.sqrt(dh).astype(
         q.dtype
     )
@@ -189,6 +252,7 @@ def generate(
     top_p: Optional[float] = None,
     kv_layout: str = "contiguous",
     page_size: int = 8,
+    kv_wire=None,
 ):
     """Returns [B, T_prompt + max_new_tokens] tokens (prompt included).
 
@@ -197,6 +261,9 @@ def generate(
     prefill + scan loop against the paged pool layout (each batch row gets
     a trivial contiguous page table) — the like-for-like proof that the
     serving engine's cache is token-identical to the contiguous one.
+    ``kv_wire`` (paged only) holds the pages block-quantized in that
+    WireFormat spelling — the like-for-like A/B for the serving engine's
+    quantized KV residency (``serve/kv_cache.py``).
     """
     if not model.decode:
         raise ValueError("generate() needs a model built with decode=True")
@@ -213,7 +280,10 @@ def generate(
 
     if kv_layout == "paged":
         return _generate_paged(model, params, prompt, max_new_tokens,
-                               rng=rng, page_size=page_size, **kw)
+                               rng=rng, page_size=page_size,
+                               kv_wire=kv_wire, **kw)
+    if kv_wire is not None:
+        raise ValueError("kv_wire quantized residency needs kv_layout='paged'")
 
     cache = init_cache(model, b, total)
 
@@ -245,13 +315,18 @@ def generate(
 
 
 def _generate_paged(model, params, prompt, max_new_tokens, *, rng,
-                    page_size, **kw):
+                    page_size, kv_wire=None, **kw):
     """The same prefill + scan loop over the paged pool layout."""
+    from ..serve.kv_cache import kv_wire_format
+
     b, t_prompt = prompt.shape
     total = t_prompt + max_new_tokens
     max_pages = math.ceil(total / page_size)
     # page 0 is the reserved null page; row i owns a contiguous run
-    paged_model = model.clone(paged=(1 + b * max_pages, page_size))
+    paged_model = model.clone(
+        paged=(1 + b * max_pages, page_size),
+        kv_wire=kv_wire_format(kv_wire),
+    )
     page_table = jnp.asarray(
         1 + jnp.arange(b)[:, None] * max_pages + jnp.arange(max_pages),
         jnp.int32,

@@ -27,7 +27,12 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 from ..precision import fp8_dot_general_cls
-from .generate import paged_attention, write_paged_kv
+from .generate import (
+    kv_scale_block,
+    paged_attention,
+    quantize_kv,
+    write_paged_kv,
+)
 from .scan_utils import remat_block
 
 AttnFn = Callable[..., jnp.ndarray]  # (q, k, v, *, causal) -> out
@@ -155,6 +160,10 @@ class Block(nn.Module):
     # scan-body mode: return (x, None) so the block slots into nn.scan
     as_scan_body: bool = False
     paged: tuple | None = None  # (num_pages, page_size) page-pool KV layout
+    # block-scaled quantized page residency (serve/kv_cache.py): a resolved
+    # parallel/compressed.WireFormat; the "pages" collection then holds
+    # narrow payloads + per-block f32 scales instead of cfg.dtype K/V
+    kv_wire: Optional[object] = None
 
     def _cached_attention(self, q, k, v, idx):
         """[B, T, H, Dh] step against the persistent cache; ``idx`` is the
@@ -188,19 +197,48 @@ class Block(nn.Module):
         """
         n_pages, page = self.paged
         h, dh = q.shape[2], q.shape[3]
+        fmt = self.kv_wire
+        kv_dtype = fmt.payload_dtype if fmt is not None else k.dtype
         is_initialized = self.has_variable("pages", "k_pages")
         kp = self.variable(
-            "pages", "k_pages", jnp.zeros, (n_pages, page, h, dh), k.dtype
+            "pages", "k_pages", jnp.zeros, (n_pages, page, h, dh), kv_dtype
         )
         vp = self.variable(
-            "pages", "v_pages", jnp.zeros, (n_pages, page, h, dh), v.dtype
+            "pages", "v_pages", jnp.zeros, (n_pages, page, h, dh), kv_dtype
         )
+        ks = vs = None
+        if fmt is not None:
+            blk = kv_scale_block(fmt, h, dh)
+            n_scales = (h * dh) // blk
+            ks = self.variable(
+                "pages", "k_scales", jnp.zeros,
+                (n_pages, page, n_scales), jnp.float32,
+            )
+            vs = self.variable(
+                "pages", "v_scales", jnp.zeros,
+                (n_pages, page, n_scales), jnp.float32,
+            )
         if not is_initialized:  # init pass defines pool shapes only
             return default_attention(q, k, v, causal=True)
+        if fmt is None:
+            kp.value, vp.value = write_paged_kv(
+                kp.value, vp.value, k, v, page_table, lengths
+            )
+            return paged_attention(q, kp.value, vp.value, page_table, lengths)
+        # quantize on page write: payload and scales scatter with the same
+        # (phys, off) indexing; dequantize happens in the gathered read
+        qk, sk = quantize_kv(k, fmt, blk)
+        qv, sv = quantize_kv(v, fmt, blk)
         kp.value, vp.value = write_paged_kv(
-            kp.value, vp.value, k, v, page_table, lengths
+            kp.value, vp.value, qk, qv, page_table, lengths
         )
-        return paged_attention(q, kp.value, vp.value, page_table, lengths)
+        ks.value, vs.value = write_paged_kv(
+            ks.value, vs.value, sk, sv, page_table, lengths
+        )
+        return paged_attention(
+            q, kp.value, vp.value, page_table, lengths,
+            k_scales=ks.value, v_scales=vs.value,
+        )
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True, start_index=None,
@@ -265,6 +303,10 @@ class GPT2(nn.Module):
     attn_fn: AttnFn = default_attention
     decode: bool = False
     paged: tuple | None = None  # (num_pages, page_size); needs decode=True
+    # quantized page residency (with ``paged``): resolved WireFormat whose
+    # payload dtype + per-block f32 scales replace cfg.dtype pages — see
+    # serve/kv_cache.py for the format table and HBM accounting
+    kv_wire: Optional[object] = None
 
     @nn.compact
     def __call__(self, tokens, deterministic: bool = True, *,
@@ -278,6 +320,8 @@ class GPT2(nn.Module):
             "wpe", nn.initializers.normal(0.01), (cfg.n_positions, cfg.n_embd)
         )
         start_index = None  # blocks' global KV write position this call
+        if self.kv_wire is not None and self.paged is None:
+            raise ValueError("kv_wire quantized pages require the paged layout")
         if self.paged is not None:
             if not self.decode:
                 raise ValueError("paged KV layout requires decode=True")
@@ -330,7 +374,7 @@ class GPT2(nn.Module):
             for i in range(cfg.n_layer):
                 x = block_cls(
                     cfg, self.attn_fn, self.decode, paged=self.paged,
-                    name=f"h_{i}",
+                    kv_wire=self.kv_wire, name=f"h_{i}",
                 )(x, deterministic, start_index, page_table, lengths)
 
         x = nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name="ln_f")(x)

@@ -988,6 +988,35 @@ def load_trajectory(root: str | None = None) -> list:
     return out
 
 
+_SUMMARY_HEADLINES = {
+    # summary records carry no top-level "value"; the regression sentry
+    # trends their headline metric instead. serve_bench.py's serve_slo
+    # record headlines the decode fast path's throughput claim — the
+    # number speculative decoding exists to move.
+    "serve_slo": ("decode_tokens_per_sec_spec", "tok/s"),
+}
+
+
+def headline_record(rec):
+    """Map a summary record (no top-level ``value``) onto its headline
+    metric so :func:`regression_verdict` can trend it; anything already
+    carrying a ``value`` — or a summary without its headline field —
+    passes through unchanged."""
+    rec = _unwrap(rec)
+    if not isinstance(rec, dict) or rec.get("value") is not None:
+        return rec
+    pick = _SUMMARY_HEADLINES.get(rec.get("metric"))
+    if not pick or rec.get(pick[0]) is None:
+        return rec
+    name, unit = pick
+    out = dict(rec)
+    out.update(
+        metric=name, value=float(rec[name]), unit=unit,
+        headline_of=rec.get("metric"),
+    )
+    return out
+
+
 def metric_direction(rec: dict) -> str:
     """Which way is worse: ``higher``-is-better (throughput, MFU) or
     ``lower``-is-better (latencies, recovery times)."""

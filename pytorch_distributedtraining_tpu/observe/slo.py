@@ -43,7 +43,9 @@ from .goodput import _merged_total
 PHASES = (
     "queue_wait",  # enqueue -> slot admit
     "prefill",     # per chunk; attrs: bucket, tokens, padding_fraction
-    "decode",      # per batched tick; attrs: active_slots, share, padding
+    "decode",      # per batched tick; attrs: active_slots, share, padding;
+                   # speculative ticks add spec_k, draft_s, verify_s,
+                   # proposed, accepted (see spec_attribution)
     "tile",        # SwinIR tile batches; attrs: tiles, share, padding
     "stall",       # slow-reader/client time at delivery
     "deliver",     # record assembly + handoff
@@ -356,6 +358,56 @@ def tail_attribution(records: list, q: float = 99.0) -> dict:
         "padding_fraction": round(
             padding_s / compute_s, 4
         ) if compute_s > 0 else 0.0,
+    }
+
+
+def spec_attribution(records: list) -> dict:
+    """Decode-phase draft/verify sub-attribution + realized accept-rate.
+
+    Speculative decode ticks bill one ``decode`` interval per resident
+    slot whose attrs carry the tick's host draft time, batched verify
+    time, and the proposed/accepted draft counts. Because every resident
+    slot is billed the full tick (phases sum to per-request wall), the
+    tick-level seconds are recovered by ``share``-weighting each
+    interval — ``sum(share * attr)`` over slots re-assembles one tick's
+    wall exactly once. Returns the aggregate: where speculative decode
+    time went (draft vs verify) and what it bought (accept rate, tokens
+    per verify-second) — the honest speedup decomposition the bench and
+    the serve-spec-regress rule read.
+    """
+    decode_request_s = 0.0
+    draft_s = verify_s = 0.0
+    proposed = accepted = tokens = 0
+    spec_intervals = 0
+    for rec in records:
+        for phase, a, b, attrs in rec.get("intervals") or ():
+            if phase != "decode":
+                continue
+            decode_request_s += max(0.0, b - a)
+            at = attrs or {}
+            if "spec_k" not in at:
+                continue
+            spec_intervals += 1
+            share = float(at.get("share", 1.0))
+            draft_s += float(at.get("draft_s", 0.0)) * share
+            verify_s += float(at.get("verify_s", 0.0)) * share
+            proposed += int(at.get("proposed", 0))
+            accepted += int(at.get("accepted", 0))
+            tokens += int(at.get("tokens", 0))
+    return {
+        "decode_request_seconds": round(decode_request_s, 6),
+        "draft_seconds": round(draft_s, 6),
+        "verify_seconds": round(verify_s, 6),
+        "spec_intervals": spec_intervals,
+        "proposed": proposed,
+        "accepted": accepted,
+        "accept_rate": round(
+            accepted / proposed, 4
+        ) if proposed else 1.0,
+        "tokens": tokens,
+        "tokens_per_verify_second": round(
+            tokens / verify_s, 2
+        ) if verify_s > 0 else 0.0,
     }
 
 

@@ -41,6 +41,13 @@ Env knobs (the ``GRAFT_SERVE_*`` family, resolved by
 ``GRAFT_SERVE_TILE``         SwinIR tile edge (default 48)
 ``GRAFT_SERVE_TILE_BATCH``   tiles per compiled SwinIR batch (default 4)
 ``GRAFT_SERVE_TILE_OVERLAP`` tile overlap in pixels (default 8)
+``GRAFT_SERVE_SPEC_K``       speculative draft depth per decode tick
+                             (default 0 = off; >= 2 enables the
+                             ``[n_slots, k]`` verify program — greedy
+                             sampling only)
+``GRAFT_SERVE_KV_WIRE``      quantized KV page residency: a WireFormat
+                             spelling ("int8_block" / "fp8_e4m3", optional
+                             ``:block``) — default unset = dense pages
 ===========================  ==============================================
 
 SLO knobs (the ``GRAFT_SERVE_SLO_*`` family, resolved by
@@ -129,6 +136,8 @@ def serve_knobs_from_env(env=None) -> dict:
         max_len=_int("GRAFT_SERVE_MAX_LEN", 0) or None,
         prefill_chunk=_int("GRAFT_SERVE_PREFILL_CHUNK", 32),
         prefill_buckets=buckets,
+        spec_k=_int("GRAFT_SERVE_SPEC_K", 0),
+        kv_wire=(e.get("GRAFT_SERVE_KV_WIRE") or "").strip() or None,
     )
 
 

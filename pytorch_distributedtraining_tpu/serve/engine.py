@@ -11,6 +11,19 @@ open-ended request stream without ever changing a shape:
   at its own length. Slots without an active decode get a **null page
   table row** (all zeros → physical page 0) and length 0, so their writes
   land in trash and their sampled token is ignored on the host.
+- with ``spec_k >= 2``, exactly ONE more program: the speculative verify
+  step at ``[n_slots, spec_k]``. A host-side self-drafting pass proposes
+  ``spec_k - 1`` tokens per resident slot from the sequence's own history
+  (most recent earlier occurrence of the context's tail n-gram — no draft
+  model, no extra compiled program), the verify step scores all proposals
+  in one batched dispatch, and the longest prefix of drafts matching the
+  model's own greedy outputs is accepted — between 1 and ``spec_k``
+  tokens per tick. Accepted tokens are exactly the sequential greedy
+  outputs **by construction** (each verify position is conditioned on the
+  accepted prefix), so speculative decode is token-identical to vanilla
+  and requires ``temperature == 0``. Rejected-draft K/V writes past the
+  accepted length are garbage the write-before-read invariant absorbs:
+  the next tick re-writes those positions before any query reads them.
 
 Admission, retirement, and page accounting are host-side
 (:mod:`.scheduler`), so joining or finishing a request never touches the
@@ -63,7 +76,7 @@ from ..observe import slo as _slo
 from ..observe import trace
 from ..resilience.faults import InjectedFault, fault_point
 from ..runtime.cache import jit_cache_size
-from .kv_cache import PagePool
+from .kv_cache import PagePool, kv_bytes_per_slot, kv_wire_format
 from .scheduler import (
     DECODE,
     DROPPED,
@@ -84,6 +97,16 @@ runtime_stats = {
     "steady_recompiles": 0,
     "jit_entries_at_steady": 0,
     "jit_entries_now": 0,
+    # speculative-decode health (analyze rule ``serve-spec-regress``):
+    # rolling accept-rate below GRAFT_SPEC_ACCEPT_FLOOR is the WARN,
+    # spec_enabled + steady_recompiles > 0 is the ERROR (the one extra
+    # verify program must join the closed set at warmup, never under load)
+    "spec_enabled": 0,
+    "spec_k": 0,
+    "spec_ticks": 0,
+    "spec_proposed": 0,
+    "spec_accepted": 0,
+    "spec_accept_rate": 1.0,
 }
 
 # Rolling serve-latency histograms for the fleet metrics plane: every
@@ -98,6 +121,25 @@ rolling_hists: dict = {}
 # measures the whole per-tick bookkeeping cost), the fleet plane
 # publishes them per rank next to the histograms.
 rolling_gauges: dict = {}
+
+
+def accept_drafts(drafts, greedy, budget: int) -> int:
+    """Longest-matching-prefix accept count for one slot's verify output.
+
+    ``drafts``: the ``spec_k - 1`` proposed tokens fed at verify input
+    positions ``1..spec_k-1``; ``greedy[j]``: the model's greedy token
+    following input position ``j``. ``greedy[0]`` is conditioned only on
+    already-accepted context, so it is ALWAYS accepted (a speculative
+    tick never yields fewer tokens than a vanilla one); ``greedy[n]`` is
+    valid iff every draft before it matched the greedy token at its own
+    position. ``budget`` caps acceptance at the request's remaining
+    ``max_new_tokens`` so a tick can never overshoot the token budget.
+    """
+    n = 1
+    k = len(greedy)
+    while n < k and n < budget and int(drafts[n - 1]) == int(greedy[n - 1]):
+        n += 1
+    return min(n, max(1, int(budget)))
 
 
 def note_delivery(rec: dict) -> None:
@@ -147,9 +189,26 @@ class ServeEngine:
         seed: int = 0,
         admission: str = "continuous",
         slo: _slo.SLOTracker | None = None,
+        spec_k: int = 0,
+        kv_wire=None,
     ):
         self.cfg = cfg
         self.params = params
+        # speculative decode: draft depth per tick (0/1 = off). The accept
+        # rule compares drafts against the model's own greedy outputs, so
+        # any sampling temperature would silently diverge — refuse it here.
+        self.spec_k = max(0, int(spec_k))
+        if self.spec_k == 1:
+            self.spec_k = 0  # k=1 proposes nothing: vanilla decode
+        if self.spec_k and temperature != 0.0:
+            raise ValueError(
+                f"speculative decode (spec_k={self.spec_k}) requires greedy "
+                f"sampling (temperature=0), got temperature={temperature}: "
+                "the accepted prefix is defined as the greedy output"
+            )
+        # quantized page residency: resolve the spelling through the
+        # parallel/compressed registry (one source of truth for formats)
+        self.kv_wire = kv_wire_format(kv_wire)
         self.n_slots = int(n_slots)
         self.page_size = int(page_size)
         self.max_len = int(max_len or cfg.n_positions)
@@ -189,11 +248,13 @@ class ServeEngine:
             prefill_buckets=self.prefill_buckets,
             admission=admission,
             ledger=self.ledger,
+            spec_k=self.spec_k,
         )
 
         self.model = GPT2(
             cfg, attn_fn=attn_fn, decode=True,
             paged=(self.num_pages, self.page_size),
+            kv_wire=self.kv_wire,
         )
         self._pages = init_paged_cache(self.model, 1, self.max_pages)
         # host mirrors: the physical page table per slot and live lengths
@@ -206,6 +267,9 @@ class ServeEngine:
             b: self._build_prefill(b) for b in self.prefill_buckets
         }
         self._decode_fn = self._build_decode()
+        # the ONE extra compiled program speculative decode adds: the
+        # [n_slots, spec_k] verify step (drafting itself is host-side)
+        self._spec_fn = self._build_spec_verify() if self.spec_k else None
         self._warm = False
         self._steady_jit_entries: int | None = None
         self.cancelled: list[int] = []  # rids dropped at delivery
@@ -213,7 +277,21 @@ class ServeEngine:
         self._occupancy_samples: list[float] = []
         self._tick = 0
         self._slow_reader_s = 0.0
+        # decode-throughput + speculative accounting (metrics headline:
+        # decode_tokens_per_sec = accepted decode tokens / decode wall)
+        self._decode_s = 0.0
+        self._draft_s = 0.0
+        self._decode_tokens = 0
+        self._spec_ticks = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        # rolling accept window (last 256 verify ticks) feeding the
+        # serve_spec_accept_rate gauge and the serve-spec-regress rule
+        self._spec_window: list[tuple[int, int]] = []
         runtime_stats["engines_built"] += 1
+        if self.spec_k:
+            runtime_stats["spec_enabled"] = 1
+            runtime_stats["spec_k"] = self.spec_k
 
     # -- compiled programs -------------------------------------------------
 
@@ -246,6 +324,27 @@ class ServeEngine:
             return mutated["pages"], tok
 
         return jax.jit(decode, donate_argnums=self._donate())
+
+    def _build_spec_verify(self):
+        """The batched speculative verify step at ``[n_slots, spec_k]``.
+
+        Column 0 carries each slot's real newest token, columns 1.. carry
+        the host-drafted proposals. The paged model banks K/V for all
+        ``spec_k`` positions and returns its greedy next-token at every
+        one — the host then accepts the longest draft prefix that matched
+        (:func:`accept_drafts`). Greedy-only by contract, so no rng.
+        """
+        model = self.model
+
+        def spec_verify(params, pages, tokens, page_table, lengths):
+            logits, mutated = model.apply(
+                {"params": params, "pages": pages}, tokens,
+                page_table=page_table, lengths=lengths, mutable=["pages"],
+            )
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return mutated["pages"], tok
+
+        return jax.jit(spec_verify, donate_argnums=self._donate())
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -298,11 +397,32 @@ class ServeEngine:
                 jax.block_until_ready(tok)
             self._pages = pages
             report.setdefault("decode", time.perf_counter() - t0)
+            if self._spec_fn is not None:
+                t0 = time.perf_counter()
+                with trace.bucket_dispatch_span(
+                    self, "serve.spec_verify", self.spec_k
+                ):
+                    pages, tok = self._spec_fn(
+                        self.params, self._pages,
+                        jnp.zeros((self.n_slots, self.spec_k), jnp.int32),
+                        jnp.zeros(
+                            (self.n_slots, self.max_pages), jnp.int32
+                        ),
+                        jnp.zeros((self.n_slots,), jnp.int32),
+                    )
+                    jax.block_until_ready(tok)
+                self._pages = pages
+                report.setdefault(
+                    "spec_verify", time.perf_counter() - t0
+                )
         self._warm = True
         return report
 
     def _all_jitted(self):
-        return (*self._prefill_fns.values(), self._decode_fn)
+        fns = (*self._prefill_fns.values(), self._decode_fn)
+        if self._spec_fn is not None:
+            fns = (*fns, self._spec_fn)
+        return fns
 
     def mark_steady(self) -> int:
         """Snapshot the compiled-program count; growth after this point is
@@ -369,7 +489,129 @@ class ServeEngine:
         )
         return True
 
+    def _draft(self, st) -> list[int]:
+        """Self-drafted proposal for one slot: ``spec_k - 1`` tokens.
+
+        The draft pass runs over the sequence's own history (prompt +
+        generated so far): find the most recent earlier occurrence of the
+        context's tail n-gram (longest of 3/2/1) and propose the tokens
+        that followed it — prompt-lookup self-speculation. Greedy decode
+        loves to revisit its own n-grams, so realized accept-rates are
+        high exactly when decode is the bottleneck (long repetitive
+        generations). A miss falls back to repeating the newest token;
+        any draft is SAFE (the verify step discards mismatches), drafts
+        only change throughput, never tokens.
+        """
+        need = self.spec_k - 1
+        ctx = st.req.prompt.tolist() + st.tokens
+        out: list[int] = []
+        for n in (3, 2, 1):
+            if len(ctx) <= n:
+                continue
+            tail = ctx[-n:]
+            for i in range(len(ctx) - n - 1, -1, -1):
+                if ctx[i:i + n] == tail:
+                    out = ctx[i + n:i + n + need]
+                    break
+            if out:
+                break
+        while len(out) < need:
+            out.append(out[-1] if out else ctx[-1])
+        return out[:need]
+
+    def _spec_decode_tick(self, now: float) -> list:
+        """One speculative quantum: host draft pass → one ``[n_slots,
+        spec_k]`` verify dispatch → longest-matching-prefix accept.
+
+        Each slot banks between 1 and ``spec_k`` tokens (never fewer than
+        vanilla). ``lengths`` advances by the accept count: the accepted
+        inputs are now real cache history, the newest accepted token is
+        fed back as the next tick's column 0, and rejected-draft K/V past
+        the new length is garbage the next tick overwrites before any
+        read (module docstring).
+        """
+        active = self.sched.decoding()
+        if not active:
+            return []
+        k = self.spec_k
+        pt = np.zeros_like(self._page_table)
+        lens = np.zeros_like(self._lengths)
+        toks = np.zeros((self.n_slots, k), np.int32)
+        td0 = time.perf_counter()
+        drafts: dict[int, list[int]] = {}
+        for st in active:
+            pt[st.slot] = self._page_table[st.slot]
+            lens[st.slot] = self._lengths[st.slot]
+            d = self._draft(st)
+            drafts[st.slot] = d
+            toks[st.slot, 0] = st.tokens[-1]
+            toks[st.slot, 1:] = d
+        t0 = time.perf_counter()
+        with trace.bucket_dispatch_span(self, "serve.spec_verify", k):
+            self._pages, out = self._spec_fn(
+                self.params, self._pages, jnp.asarray(toks),
+                jnp.asarray(pt), jnp.asarray(lens),
+            )
+        out = np.asarray(out)  # device sync: the tick's tokens land here
+        t1 = time.perf_counter()
+        draft_s = t0 - td0
+        verify_s = t1 - t0
+        share = round(1.0 / len(active), 4)
+        padding = round(1.0 - len(active) / self.n_slots, 4)
+        finished = []
+        tick_proposed = tick_accepted = 0
+        for st in active:
+            budget = st.req.max_new_tokens - len(st.tokens)
+            greedy = [int(x) for x in out[st.slot]]
+            n_acc = accept_drafts(drafts[st.slot], greedy, budget)
+            st.tokens.extend(greedy[:n_acc])
+            self._lengths[st.slot] += n_acc
+            tick_proposed += k - 1
+            tick_accepted += n_acc - 1
+            # decode-phase billing with draft/verify sub-attribution: the
+            # whole interval (host draft + batched verify) bills to every
+            # resident slot as `decode`, and the attrs carry where the
+            # time went + what the speculation bought this tick
+            self.ledger.add_phase(
+                st.rid, "decode", td0, t1,
+                active_slots=len(active), share=share,
+                padding_fraction=padding,
+                spec_k=k, draft_s=round(draft_s, 6),
+                verify_s=round(verify_s, 6),
+                proposed=k - 1, accepted=n_acc - 1,
+                tokens=n_acc,
+            )
+            self._decode_tokens += n_acc
+            if len(st.tokens) >= st.req.max_new_tokens:
+                finished.append(st)
+        self._decode_s += t1 - t0
+        self._draft_s += draft_s
+        self._spec_ticks += 1
+        self._spec_proposed += tick_proposed
+        self._spec_accepted += tick_accepted
+        self._spec_window.append((tick_proposed, tick_accepted))
+        if len(self._spec_window) > 256:
+            del self._spec_window[0]
+        runtime_stats["spec_ticks"] = self._spec_ticks
+        runtime_stats["spec_proposed"] = self._spec_proposed
+        runtime_stats["spec_accepted"] = self._spec_accepted
+        runtime_stats["spec_accept_rate"] = self.spec_accept_rate()
+        return finished
+
+    def spec_accept_rate(self, rolling: bool = True) -> float:
+        """Realized draft accept-rate: accepted / proposed drafts (1.0
+        when speculation never ran). ``rolling`` restricts to the last
+        256 verify ticks — the serve-spec-regress rule's window."""
+        window = self._spec_window if rolling else [
+            (self._spec_proposed, self._spec_accepted)
+        ]
+        prop = sum(p for p, _ in window)
+        acc = sum(a for _, a in window)
+        return acc / prop if prop else 1.0
+
     def _decode_tick(self, now: float) -> list:
+        if self._spec_fn is not None:
+            return self._spec_decode_tick(now)
         active = self.sched.decoding()
         if not active:
             return []
@@ -409,6 +651,8 @@ class ServeEngine:
             self._lengths[st.slot] += 1
             if len(st.tokens) >= st.req.max_new_tokens:
                 finished.append(st)
+        self._decode_s += t1 - t0
+        self._decode_tokens += len(active)
         return finished
 
     def _retire(self, finished, now: float) -> None:
@@ -508,6 +752,11 @@ class ServeEngine:
         return {
             "format": "graft-kv-migration",
             "page_size": self.page_size,
+            # quantized residency migrates BITWISE: the snapshot carries
+            # the narrow payload + scale pages exactly as they sit in the
+            # pool (no decode/re-encode round trip), so adoption on a
+            # same-format engine continues with identical cache contents
+            "kv_wire": self.kv_wire.name if self.kv_wire else None,
             "requests": metas,
             "kv": kv,
         }
@@ -523,6 +772,15 @@ class ServeEngine:
             raise ValueError(
                 f"page_size mismatch: snapshot "
                 f"{snapshot.get('page_size')} vs engine {self.page_size}"
+            )
+        mine = self.kv_wire.name if self.kv_wire else None
+        theirs = snapshot.get("kv_wire")
+        if theirs != mine:
+            raise ValueError(
+                f"kv_wire mismatch: snapshot pages are "
+                f"{theirs or 'dense'}, this engine holds "
+                f"{mine or 'dense'} — migration is bitwise on the "
+                "resident representation, never a re-encode"
             )
         kv = snapshot.get("kv")
         offset = 0
@@ -617,6 +875,10 @@ class ServeEngine:
             "serve_kv_pages_free": float(self.pool.available),
             "serve_slo_burn_rate": self.slo.burn_rate(),
         })
+        if self.spec_k:
+            rolling_gauges["serve_spec_accept_rate"] = (
+                self.spec_accept_rate()
+            )
 
     def run(self, requests, *, realtime: bool = True) -> list[dict]:
         """Serve an open-loop trace: each request is submitted at its
@@ -662,6 +924,7 @@ class ServeEngine:
         """Summary the SLO bench publishes (latency/TTFT percentiles are
         computed by the bench from the raw records; this is the engine's
         own accounting)."""
+        decode_wall = self._decode_s + self._draft_s
         return {
             "delivered": len(self.delivered),
             "dropped_at_admit": len(self.sched.dropped),
@@ -672,6 +935,51 @@ class ServeEngine:
             "compiled_programs": jit_cache_size(*self._all_jitted()),
             "slow_reader_stall_s": self._slow_reader_s,
             "slo": self.slo.snapshot(),
+            # decode throughput headline: tokens banked by decode/verify
+            # ticks over their wall time (draft pass included — speedup
+            # claims must price the drafting they depend on)
+            "decode_tokens": self._decode_tokens,
+            "decode_s": decode_wall,
+            "decode_tokens_per_sec": (
+                self._decode_tokens / decode_wall if decode_wall else 0.0
+            ),
+            "spec": {
+                "spec_k": self.spec_k,
+                "ticks": self._spec_ticks,
+                "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "accept_rate": self.spec_accept_rate(rolling=False),
+                "rolling_accept_rate": self.spec_accept_rate(),
+                "draft_s": self._draft_s,
+                "verify_s": self._decode_s if self.spec_k else 0.0,
+            },
+            "kv": self.kv_metrics(),
+        }
+
+    def kv_metrics(self) -> dict:
+        """HBM pricing of one slot's full page reservation, dense vs the
+        active residency — the honest bytes-per-slot gain claim."""
+        shape_kw = dict(
+            n_layer=self.cfg.n_layer,
+            n_head=self.cfg.n_head,
+            head_dim=self.cfg.n_embd // self.cfg.n_head,
+            page_size=self.page_size,
+            max_pages_per_slot=self.max_pages,
+        )
+        dense_elem = jnp.dtype(self.cfg.dtype).itemsize
+        dense = kv_bytes_per_slot(
+            None, dense_bytes_per_elem=dense_elem, **shape_kw
+        )
+        mine = (
+            kv_bytes_per_slot(self.kv_wire, **shape_kw)
+            if self.kv_wire is not None else dense
+        )
+        return {
+            "kv_wire": self.kv_wire.name if self.kv_wire else None,
+            "kv_bytes_per_slot": int(mine),
+            "kv_bytes_per_slot_dense": int(dense),
+            # resident slots per HBM byte, relative to dense residency
+            "slots_per_hbm_gain": dense / mine if mine else 1.0,
         }
 
     def tail_attribution(self, q: float = 99.0) -> dict:

@@ -927,6 +927,13 @@ class ServeFleet:
             )
         knobs = dict(route_knobs_from_env())
         knobs.update(route_knobs or {})
+        # any speculative engine makes the whole fleet greedy-only: replay
+        # and migration must land identical tokens on EVERY replica, and
+        # speculative verify only defines them for temperature-0 decode
+        knobs.setdefault("require_greedy", any(
+            getattr(eng, "spec_k", 0)
+            for eng in (*engines.values(), *(standby or {}).values())
+        ))
         self.router = FleetRouter(
             store, self._transport,
             migrate_handler=self._migrate, clock=clock, **knobs,

@@ -18,11 +18,82 @@ Design points:
   and makes page reuse deterministic for the scheduler tests.
 - The pool never touches jax: admission decisions are host-side and must
   stay cheap (the engine consults ``available`` every tick).
+
+Quantized page residency (PR 17): the engine can hold the "pages"
+collection block-quantized in a ``parallel/compressed.py`` WireFormat
+(int8 / fp8_e4m3 payload + per-block f32 scales) — roughly doubling
+resident slots per HBM byte at bf16 baselines. This module owns the
+host-side half: :func:`kv_wire_format` resolves spellings through the
+SAME registry the gradient wire uses (one source of truth for formats),
+and :func:`kv_bytes_per_slot` prices a slot's full page reservation so
+the bench and the engine report honest bytes-per-slot gains. The
+device-side quantize/dequantize twins live in ``models/generate.py``
+(``quantize_kv`` / ``dequantize_kv``) next to the paged primitives they
+ride. jax only loads when a wire format is actually resolved — the
+allocator itself stays import-light for the stdlib-only fleet tooling.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+
+
+def kv_wire_format(spec):
+    """Resolve a KV wire spelling to a ``WireFormat`` (or None = dense).
+
+    Accepts everything ``parallel.compressed.wire_format`` accepts — a
+    registry name (``"int8_block"``), a ``name:block`` override, an
+    already-resolved format, or an off-spelling. The import is lazy so the
+    jax-free scheduler/router processes can import this module without
+    loading jax.
+    """
+    if spec is None:
+        return None
+    from ..parallel.compressed import wire_format
+
+    return wire_format(spec)
+
+
+def kv_scale_count(fmt, n_head: int, head_dim: int) -> int:
+    """f32 scales per cached position (``models/generate.kv_scale_block``
+    restated host-side: the format's block when it divides ``H*Dh``, else
+    one scale for the whole per-position vector)."""
+    n = n_head * head_dim
+    blk = fmt.block or n
+    if n % blk:
+        blk = n
+    return n // blk
+
+
+def kv_bytes_per_slot(
+    fmt,
+    *,
+    n_layer: int,
+    n_head: int,
+    head_dim: int,
+    page_size: int,
+    max_pages_per_slot: int,
+    dense_bytes_per_elem: int = 2,
+) -> int:
+    """HBM bytes one slot's full page reservation pins, per the residency.
+
+    ``fmt=None`` prices the dense layout (``dense_bytes_per_elem`` per K/V
+    element — 2 for bf16, 4 for f32); a WireFormat prices payload bytes
+    plus the per-block f32 scales. K and V both count, across all layers.
+    """
+    elems = max_pages_per_slot * page_size * n_head * head_dim
+    if fmt is None:
+        per_layer = 2 * elems * dense_bytes_per_elem
+    else:
+        import jax.numpy as jnp
+
+        payload = elems * jnp.dtype(fmt.payload_dtype).itemsize
+        scales = (
+            max_pages_per_slot * page_size
+            * kv_scale_count(fmt, n_head, head_dim) * 4
+        )
+        per_layer = 2 * (payload + scales)
+    return n_layer * per_layer
 
 
 @dataclass

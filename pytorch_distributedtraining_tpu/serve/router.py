@@ -197,6 +197,7 @@ class FleetRouter:
         seed: int = 0,
         ledger: "_slo.RequestLedger | None" = None,
         migrate_handler=None,
+        require_greedy: bool = False,
         clock=time.monotonic,
         sleep=time.sleep,
     ):
@@ -219,6 +220,14 @@ class FleetRouter:
         # Without one (or on adoption failure) the router replays from
         # the prompt — migrate is an optimization, never a dependency.
         self.migrate_handler = migrate_handler
+        # greedy-sampling contract (speculative replicas): failover replay
+        # and KV-page migration are correct because temperature-0 decode
+        # is rng-independent — any replica regenerates the SAME tokens.
+        # When the fleet's engines run speculative decode (greedy-only by
+        # construction), a sampled request could neither replay nor verify
+        # consistently, so admission refuses it loudly (ValueError at
+        # submit) instead of risking silent token divergence mid-failover.
+        self.require_greedy = bool(require_greedy)
         self._clock = clock
         self._sleep = sleep
         self.ledger = ledger if ledger is not None else _slo.RequestLedger()
@@ -337,9 +346,23 @@ class FleetRouter:
         ``outcome`` ∈ {delivered, shed}, with ``tokens`` when delivered,
         ``replays`` counting mid-flight failovers. This method never
         raises for a replica's sake and never blocks past the deadline —
-        the never-hang contract lives here.
+        the never-hang contract lives here. The one exception is the
+        caller's OWN contract violation: a non-greedy request against a
+        speculative fleet (``require_greedy``) raises ValueError at
+        admission — before any dispatch — because replay-from-prompt and
+        KV migration would silently diverge from the sampled tokens.
         """
         rid = request["rid"]
+        if self.require_greedy and float(
+            request.get("temperature") or 0.0
+        ) != 0.0:
+            raise ValueError(
+                f"request {rid}: temperature="
+                f"{request.get('temperature')} rejected — this fleet runs "
+                "speculative decode, whose failover replay and KV-page "
+                "migration are only token-consistent under greedy "
+                "sampling (temperature=0); see docs/SERVING.md"
+            )
         t0 = self._clock()
         t0_pc = time.perf_counter()
         self.ledger.begin(rid, t=t0_pc)

@@ -14,7 +14,13 @@ Policy (kept simple and provable, in the tests' order of interest):
   latency ordering.
 - **Worst-case page reservation**: a request reserves pages for
   ``prompt_len + max_new_tokens`` at admission, so decode can never
-  deadlock mid-request waiting for a page.
+  deadlock mid-request waiting for a page. Under speculative decode
+  (``spec_k >= 2``) the reservation adds ``spec_k - 1`` tokens of
+  draft-depth headroom: a verify tick writes K/V for up to ``spec_k``
+  positions past the live length, and the final tick of a request can
+  overshoot its budget by ``spec_k - 1`` rejected drafts — headroom keeps
+  even those throwaway writes inside the slot's own pages instead of
+  spilling to the shared null page.
 - **Slots are min-id first** and pages are LIFO (see ``kv_cache``), so a
   retired request's resources go to the next admit — deterministically.
 - ``admission="static"`` is the baseline arm for the SLO bench: a new
@@ -132,6 +138,7 @@ class AdmissionScheduler:
         prefill_buckets: tuple[int, ...] = (8, 16, 32),
         admission: str = "continuous",
         ledger=None,
+        spec_k: int = 0,
     ):
         if admission not in ("continuous", "static"):
             raise ValueError(f"unknown admission policy {admission!r}")
@@ -147,6 +154,9 @@ class AdmissionScheduler:
         self.prefill_buckets = tuple(sorted(prefill_buckets))
         self.admission = admission
         self.ledger = ledger  # observe.slo.RequestLedger | None
+        # speculative draft depth (0/1 = off): page reservations add
+        # spec_k - 1 tokens of headroom per request (module docstring)
+        self.spec_k = max(0, int(spec_k))
         self.queue: deque[Request] = deque()
         self.active: dict[int, RequestState] = {}  # slot -> state
         self.free_slots: list[int] = list(range(n_slots))  # min-id first
@@ -157,13 +167,23 @@ class AdmissionScheduler:
 
     # -- submission / admission -------------------------------------------
 
+    def reserve_tokens(self, req: Request) -> int:
+        """Worst-case token positions the request can ever write: its
+        budget plus ``spec_k - 1`` draft-depth headroom (a final verify
+        tick's rejected drafts land past the budget)."""
+        return req.total_len + max(0, self.spec_k - 1)
+
     def submit(self, req: Request) -> None:
-        need = self.pool.pages_for(req.total_len)
+        need = self.pool.pages_for(self.reserve_tokens(req))
         if need > self.max_pages_per_slot:
             raise ValueError(
                 f"request {req.rid}: needs {need} pages "
-                f"(prompt {req.prompt_len} + new {req.max_new_tokens} at "
-                f"page {self.pool.page_size}) > max_pages_per_slot "
+                f"(prompt {req.prompt_len} + new {req.max_new_tokens}"
+                + (
+                    f" + spec headroom {self.spec_k - 1}"
+                    if self.spec_k >= 2 else ""
+                )
+                + f" at page {self.pool.page_size}) > max_pages_per_slot "
                 f"{self.max_pages_per_slot}"
             )
         self.queue.append(req)
@@ -181,7 +201,7 @@ class AdmissionScheduler:
         admitted = []
         while self.queue and self.free_slots:
             req = self.queue[0]
-            need = self.pool.pages_for(req.total_len)
+            need = self.pool.pages_for(self.reserve_tokens(req))
             if need > self.pool.available:
                 break  # head-of-line blocks: FIFO stays FIFO
             self.queue.popleft()

@@ -129,6 +129,16 @@ class TPUConfig:
     # off, "1" on, any other value = on with that capture dir.
     capture: bool = False
     capture_dir: str | None = None
+    # Serve decode fast path (serve/engine.py): ``serve_spec_k`` >= 2
+    # enables self-speculative decoding (draft depth per tick; greedy
+    # sampling only — the verify step defines accepted tokens as the
+    # greedy output). ``serve_kv_wire`` holds the paged KV cache
+    # block-quantized in a parallel/compressed.py WireFormat spelling
+    # ("int8_block" / "fp8_e4m3", optional :block suffix). Env twins:
+    # $GRAFT_SERVE_SPEC_K, $GRAFT_SERVE_KV_WIRE (env wins, same
+    # precedence as GRAFT_WIRE).
+    serve_spec_k: int = 0
+    serve_kv_wire: str | None = None
 
 
 @dataclass

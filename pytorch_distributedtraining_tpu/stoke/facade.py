@@ -227,6 +227,32 @@ def _telemetry_from_env(cfg):
     return bool(cfg.telemetry or trace_dir), trace_dir
 
 
+def _serve_fastpath_overrides(cfg, overrides: dict) -> dict:
+    """Fill the serve decode-fast-path knobs from TPUConfig twins.
+
+    Precedence matches GRAFT_WIRE: explicit keyword ``overrides`` win,
+    then the env knobs ($GRAFT_SERVE_SPEC_K / $GRAFT_SERVE_KV_WIRE,
+    resolved downstream by ``serve_knobs_from_env``), then
+    ``TPUConfig.serve_spec_k`` / ``TPUConfig.serve_kv_wire`` — so this
+    helper only injects a config value when neither the caller nor the
+    environment spoke.
+    """
+    out = dict(overrides)
+    if (
+        "spec_k" not in out
+        and not (os.environ.get("GRAFT_SERVE_SPEC_K") or "").strip()
+        and cfg.serve_spec_k
+    ):
+        out["spec_k"] = int(cfg.serve_spec_k)
+    if (
+        "kv_wire" not in out
+        and not (os.environ.get("GRAFT_SERVE_KV_WIRE") or "").strip()
+        and cfg.serve_kv_wire
+    ):
+        out["kv_wire"] = cfg.serve_kv_wire
+    return out
+
+
 @jax.jit
 def _ema_update(ema, val):
     """0.98-decay loss monitor folded on device (`Stoke-DDP.py:76` EMA);
@@ -1826,6 +1852,7 @@ class Stoke:
         self._require_state()
         from ..serve import build_engine
 
+        overrides = _serve_fastpath_overrides(self.tpu_config, overrides)
         return build_engine(self._module, self._state.params, **overrides)
 
     def serve_fleet(
@@ -1858,6 +1885,7 @@ class Stoke:
         from ..serve import build_engine
         from ..serve.fleet import ServeFleet
 
+        overrides = _serve_fastpath_overrides(self.tpu_config, overrides)
         n = replicas if replicas is not None else int(
             os.environ.get("GRAFT_SERVE_REPLICAS", "2") or 2
         )

@@ -194,6 +194,58 @@ class TestRouterUnits:
         assert len(set(calls)) == 2
         assert r.lifecycles_closed()
 
+    def test_require_greedy_rejects_sampled_at_admission(self):
+        # a speculative fleet is greedy-only: the accept rule and the
+        # failover/migration token-identity guarantees only exist at
+        # temperature=0, so a sampled request must be refused BEFORE any
+        # replica sees it — a clear ValueError, not a shed
+        store = _stub_store(("a", 0.0, 9.0))
+        calls = []
+
+        def transport(replica, request, timeout_s):
+            calls.append(request["rid"])
+            return {"ok": True, "tokens": [1]}
+
+        r = _router(store, transport, require_greedy=True)
+        with pytest.raises(ValueError, match="greedy"):
+            r.submit({"rid": 3, "prompt": [1], "max_new_tokens": 2,
+                      "temperature": 0.7})
+        assert calls == []  # rejected at admission, never dispatched
+        assert r.lifecycles_closed()
+        # temperature=0 — explicit or absent — still admits
+        for rid, req in enumerate((
+            {"rid": 4, "prompt": [1], "max_new_tokens": 2,
+             "temperature": 0.0},
+            {"rid": 5, "prompt": [1], "max_new_tokens": 2},
+        )):
+            assert r.submit(req)["outcome"] == "delivered"
+
+    def test_fleet_auto_requires_greedy_with_spec_engine(self, tmp_path):
+        # ServeFleet flips require_greedy on when ANY engine (active or
+        # standby) runs speculative decode
+        spec_eng = FakeEngine()
+        spec_eng.spec_k = 4
+        fleet = ServeFleet(
+            {"r0": FakeEngine(), "r1": spec_eng},
+            root=str(tmp_path / "fleet-spec"),
+        )
+        try:
+            assert fleet.router.require_greedy
+            with pytest.raises(ValueError, match="greedy"):
+                fleet.submit({
+                    "rid": 0, "prompt": [1, 2], "max_new_tokens": 2,
+                    "temperature": 0.5,
+                })
+        finally:
+            fleet.stop()
+        vanilla = ServeFleet(
+            {"r0": FakeEngine()}, root=str(tmp_path / "fleet-vanilla"),
+        )
+        try:
+            assert not vanilla.router.require_greedy
+        finally:
+            vanilla.stop()
+
     def test_breaker_opens_then_half_open_recovers(self):
         store = _stub_store(("a", 0.0, 1.0))
         clock = FakeClock()

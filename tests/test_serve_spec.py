@@ -1,0 +1,491 @@
+"""Decode fast path: self-speculative decoding + quantized paged KV.
+
+The load-bearing guarantees: the accept rule banks exactly the
+sequential greedy tokens (speculative serving is token-identical to
+vanilla by construction, not by tolerance); the compiled set grows by
+exactly ONE warmed program and steady state still compiles nothing;
+quantized page residency decodes the same tokens as dense on the tiny
+config and migrates bitwise (never re-encoded); the scheduler's
+draft-depth headroom keeps verify overshoot inside owned pages through
+the shed path; the knobs round-trip env -> engine and TPUConfig ->
+facade; and the ``serve-spec-regress`` graftcheck rule fires on seeded
+violations.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributedtraining_tpu.analyze import (
+    AnalysisContext,
+    Severity,
+    run_rules,
+)
+from pytorch_distributedtraining_tpu.models import GPT2, GPT2Config
+from pytorch_distributedtraining_tpu.models.generate import generate
+from pytorch_distributedtraining_tpu.resilience.faults import (
+    FaultPlan,
+    install_plan,
+)
+from pytorch_distributedtraining_tpu.serve import serve_knobs_from_env
+from pytorch_distributedtraining_tpu.serve.engine import (
+    ServeEngine,
+    accept_drafts,
+    runtime_stats,
+)
+from pytorch_distributedtraining_tpu.serve.kv_cache import (
+    PagePool,
+    kv_bytes_per_slot,
+    kv_wire_format,
+)
+from pytorch_distributedtraining_tpu.serve.scheduler import (
+    DECODE,
+    AdmissionScheduler,
+    Request,
+)
+from pytorch_distributedtraining_tpu.stoke.config import TPUConfig
+from pytorch_distributedtraining_tpu.stoke.facade import (
+    _serve_fastpath_overrides,
+)
+
+CFG = GPT2Config.tiny(n_embd=32, n_head=4, n_positions=96)
+
+BASE = dict(
+    n_slots=3, page_size=8, max_len=48, prefill_chunk=16,
+    prefill_buckets=(8, 16), temperature=0.0,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = GPT2(CFG)
+    tok = jnp.zeros((1, 8), jnp.int32)
+    return model.init(jax.random.PRNGKey(0), tok)["params"]
+
+
+def _engine(params, **kw):
+    base = dict(BASE)
+    base.update(kw)
+    return ServeEngine(CFG, params, **base)
+
+
+def _reqs(n=6, seed=0):
+    # fresh RandomState per call: two draws from a shared generator
+    # would hand the arms different prompts
+    rng = np.random.RandomState(seed)
+    return [
+        Request(
+            i,
+            rng.randint(0, CFG.vocab_size, size=int(rng.randint(3, 14)))
+            .astype(np.int32),
+            int(rng.randint(4, 10)),
+        )
+        for i in range(n)
+    ]
+
+
+def _tokens(records):
+    return {r["rid"]: list(r["tokens"]) for r in records}
+
+
+class TestAcceptDrafts:
+    """The accept rule against hand-computed traces: greedy[0] always
+    banks; greedy[n] is valid iff every draft before it matched."""
+
+    def test_all_drafts_verified(self):
+        assert accept_drafts([5, 6, 7], [5, 6, 7, 9], budget=10) == 4
+
+    def test_first_draft_wrong_banks_one(self):
+        assert accept_drafts([5, 6, 7], [4, 6, 7, 9], budget=10) == 1
+
+    def test_partial_prefix(self):
+        # drafts 5,6 match greedy 5,6; third draft 7 != greedy 8 — the
+        # tokens banked are 5,6,8: greedy[2]=8 was computed from the
+        # verified prefix, so it banks too
+        assert accept_drafts([5, 6, 7], [5, 6, 8, 2], budget=10) == 3
+
+    def test_budget_caps_acceptance(self):
+        assert accept_drafts([5, 6, 7], [5, 6, 7, 9], budget=2) == 2
+        assert accept_drafts([5, 6, 7], [5, 6, 7, 9], budget=1) == 1
+
+    def test_budget_floor_is_one(self):
+        # the verify tick already computed greedy[0]; a request with one
+        # token of budget left still banks it
+        assert accept_drafts([5], [5, 6], budget=0) == 1
+
+
+class TestSpecTokenIdentity:
+    def test_spec_serving_matches_vanilla_greedy(self, params):
+        """THE tentpole guarantee: same trace, same tokens, fewer ticks."""
+        vanilla = _engine(params)
+        ref = _tokens(vanilla.run(_reqs(), realtime=False))
+        spec = _engine(params, spec_k=4)
+        got = _tokens(spec.run(_reqs(), realtime=False))
+        assert got == ref
+        m = spec.metrics()["spec"]
+        assert m["ticks"] > 0 and m["proposed"] > 0
+
+    def test_accounting_reassembles_from_counters(self, params):
+        """Every verify tick banks 1 + accepted tokens per active slot:
+        the engine's counters must reassemble exactly."""
+        eng = _engine(params, spec_k=4)
+        eng.run(_reqs(), realtime=False)
+        m = eng.metrics()
+        spec = m["spec"]
+        assert spec["proposed"] % (spec["spec_k"] - 1) == 0
+        slot_ticks = spec["proposed"] // (spec["spec_k"] - 1)
+        assert m["decode_tokens"] == slot_ticks + spec["accepted"]
+        assert spec["accept_rate"] == pytest.approx(
+            spec["accepted"] / spec["proposed"]
+        )
+        assert 0.0 <= spec["rolling_accept_rate"] <= 1.0
+        # the published gauge mirrors the engine's counters
+        assert runtime_stats["spec_accept_rate"] == pytest.approx(
+            spec["accept_rate"]
+        )
+
+
+class TestSpecAttribution:
+    def test_ledger_reassembles_draft_verify_split(self, params):
+        """The lifecycle ledger's decode intervals carry the draft/verify
+        sub-attribution; share-weighting reassembles the engine's own
+        counters exactly (each tick's wall billed once, not per slot)."""
+        from pytorch_distributedtraining_tpu.observe import slo as slo_mod
+
+        eng = _engine(params, spec_k=4)
+        eng.run(_reqs(), realtime=False)
+        att = slo_mod.spec_attribution(eng.ledger.completed)
+        m = eng.metrics()["spec"]
+        assert att["spec_intervals"] > 0
+        assert att["proposed"] == m["proposed"]
+        assert att["accepted"] == m["accepted"]
+        assert att["accept_rate"] == pytest.approx(
+            m["accept_rate"], abs=1e-4
+        )
+        assert att["tokens"] == eng.metrics()["decode_tokens"]
+        assert att["draft_seconds"] == pytest.approx(
+            m["draft_s"], rel=0.02, abs=1e-4
+        )
+        assert att["verify_seconds"] == pytest.approx(
+            m["verify_s"], rel=0.02, abs=1e-4
+        )
+        assert att["tokens_per_verify_second"] > 0
+
+    def test_vanilla_records_have_no_spec_intervals(self, params):
+        from pytorch_distributedtraining_tpu.observe import slo as slo_mod
+
+        eng = _engine(params)
+        eng.run(_reqs(3, seed=1), realtime=False)
+        att = slo_mod.spec_attribution(eng.ledger.completed)
+        assert att["spec_intervals"] == 0
+        assert att["accept_rate"] == 1.0
+        assert att["decode_request_seconds"] > 0
+
+
+class TestCompiledSurface:
+    def test_exactly_one_extra_program_zero_steady_recompiles(self, params):
+        eng = _engine(params, spec_k=4)
+        eng.run(_reqs(), realtime=False)
+        m = eng.metrics()
+        # prefill per bucket + vanilla decode + ONE spec verify program
+        assert m["compiled_programs"] == len(BASE["prefill_buckets"]) + 2
+        assert m["steady_recompiles"] == 0
+
+    def test_spec_k_one_is_vanilla(self, params):
+        eng = _engine(params, spec_k=1)
+        assert eng.spec_k == 0 and eng._spec_fn is None
+
+
+class TestQuantizedPagedTolerance:
+    @pytest.mark.parametrize("wire", ["int8_block", "fp8_e4m3"])
+    def test_generate_paged_quantized_matches_dense(self, params, wire):
+        """The like-for-like A/B: the paged loop over quantized pages
+        decodes the same tokens as the dense paged loop on the tiny
+        config (block-scaled error stays under every argmax margin)."""
+        model = GPT2(CFG, decode=True)
+        rng = np.random.RandomState(3)
+        prompt = jnp.asarray(
+            rng.randint(0, CFG.vocab_size, size=(2, 6)), jnp.int32
+        )
+        kw = dict(temperature=0.0, kv_layout="paged", page_size=8)
+        dense = generate(model, params, prompt, 10, **kw)
+        quant = generate(model, params, prompt, 10, kv_wire=wire, **kw)
+        np.testing.assert_array_equal(
+            np.asarray(dense), np.asarray(quant)
+        )
+
+    @pytest.mark.parametrize("wire", ["int8_block", "fp8_e4m3"])
+    def test_engine_quantized_matches_dense(self, params, wire):
+        dense = _tokens(_engine(params).run(_reqs(), realtime=False))
+        q_eng = _engine(params, kv_wire=wire)
+        assert _tokens(q_eng.run(_reqs(), realtime=False)) == dense
+        # the residency pricing the engine publishes is the real ratio
+        kv = q_eng.metrics()["kv"]
+        assert kv["kv_wire"] == wire
+        assert kv["kv_bytes_per_slot"] < kv["kv_bytes_per_slot_dense"]
+        assert kv["slots_per_hbm_gain"] > 1.0
+
+    def test_spec_over_quantized_pages_composes(self, params):
+        """Both fast-path levers at once, still token-identical."""
+        ref = _tokens(_engine(params).run(_reqs(), realtime=False))
+        both = _engine(params, spec_k=4, kv_wire="int8_block")
+        assert _tokens(both.run(_reqs(), realtime=False)) == ref
+        m = both.metrics()
+        assert m["steady_recompiles"] == 0
+        assert m["spec"]["ticks"] > 0
+
+    def test_bytes_per_slot_math(self):
+        fmt = kv_wire_format("int8_block")
+        shape = dict(
+            n_layer=2, n_head=4, head_dim=8, page_size=8,
+            max_pages_per_slot=6,
+        )
+        dense = kv_bytes_per_slot(None, dense_bytes_per_elem=2, **shape)
+        mine = kv_bytes_per_slot(fmt, **shape)
+        # H*Dh=32 < block 256 -> one f32 scale per position per tensor:
+        # dense 2*32=64 B/pos vs 32+4=36 B/pos, for K and V, 48 pos, 2 layers
+        assert dense == 2 * 2 * 32 * 48 * 2
+        assert mine == 2 * (32 + 4) * 48 * 2
+
+
+class TestQuantizedMigrationBitwise:
+    def _decode_partway(self, eng, prompt, n_new):
+        eng.submit(Request(0, list(prompt), n_new))
+        now = 0.0
+        while True:
+            eng.tick(now)
+            now += 0.01
+            st = next(iter(eng.sched.active.values()), None)
+            if st is not None and st.state == DECODE and len(st.tokens) >= 4:
+                return now
+
+    def test_adopted_quantized_pages_continue_identically(self, params):
+        """Migration is bitwise ON the quantized representation: payload
+        and scale pages travel raw, and the adopter's continuation
+        matches an uninterrupted quantized run exactly."""
+        prompt, n_new = [11, 7, 5, 3], 12
+        wire = "int8_block"
+        ref = _engine(params, kv_wire=wire).run(
+            [Request(0, list(prompt), n_new)], realtime=False
+        )[0]["tokens"]
+
+        src = _engine(params, kv_wire=wire)
+        now = self._decode_partway(src, prompt, n_new)
+        snap = src.export_decode_state()
+        assert snap["kv_wire"] == wire
+        # narrow payload leaves stay narrow in the snapshot — no decode/
+        # re-encode round trip anywhere on the migration path
+        payload_dtypes = {
+            np.asarray(leaf).dtype
+            for leaf in jax.tree_util.tree_leaves(snap["kv"])
+        }
+        assert np.dtype(np.int8) in payload_dtypes
+
+        dst = _engine(params, kv_wire=wire)
+        dst.warmup()
+        assert dst.adopt(snap) == [0]
+        while dst.sched.active or dst.sched.queue:
+            dst.tick(now)
+            now += 0.01
+        rec = next(r for r in dst.delivered if r["rid"] == 0)
+        assert rec["tokens"] == ref
+
+    def test_cross_format_adoption_refused(self, params):
+        src = _engine(params, kv_wire="int8_block")
+        self._decode_partway(src, [9, 2, 4], 8)
+        snap = src.export_decode_state()
+        dense = _engine(params)
+        with pytest.raises(ValueError, match="kv_wire mismatch"):
+            dense.adopt(snap)
+
+
+class TestSchedulerHeadroom:
+    def test_reservation_includes_draft_overshoot(self):
+        pool = PagePool(num_pages=32, page_size=8)
+        sched = AdmissionScheduler(
+            n_slots=2, pool=pool, max_pages_per_slot=6,
+            prefill_chunk=8, prefill_buckets=(8,), spec_k=4,
+        )
+        req = Request(0, [1, 2, 3], 5)
+        # prompt 3 + max_new 5 + (spec_k - 1) = 11 tokens -> 2 pages
+        assert sched.reserve_tokens(req) == 11
+        sched.submit(req)
+        sched.admit(now=0.0)
+        assert pool.in_use == pool.pages_for(11)
+
+    def test_zero_spec_k_reserves_vanilla(self):
+        pool = PagePool(num_pages=32, page_size=8)
+        sched = AdmissionScheduler(
+            n_slots=2, pool=pool, max_pages_per_slot=6,
+            prefill_chunk=8, prefill_buckets=(8,),
+        )
+        req = Request(0, [1, 2, 3], 5)
+        assert sched.reserve_tokens(req) == req.total_len
+
+    def test_spec_shed_path_returns_headroom_pages(self, params):
+        """The shed-path pool invariant holds with draft headroom in the
+        reservation: admission faults under a speculative engine leak
+        neither pages nor slots."""
+        install_plan(FaultPlan.from_json([
+            {"site": "serve.admit", "action": "raise", "at": 1,
+             "times": 2},
+        ]))
+        try:
+            eng = _engine(params, spec_k=4)
+            free0 = eng.pool.available
+            records = eng.run(_reqs(5, seed=2), realtime=False)
+        finally:
+            install_plan(None)
+        assert len(records) == 3
+        assert len(eng.sched.dropped) == 2
+        assert eng.pool.in_use == 0
+        assert eng.pool.available == free0
+        eng.pool.check_invariants()
+        assert eng.sched.free_slots == list(range(eng.sched.n_slots))
+        # delivered requests banked their full budget: verify overshoot
+        # never cannibalized another request's reservation
+        for r in records:
+            assert len(r["tokens"]) == r["new_tokens"]
+
+
+class TestKnobsAndFacade:
+    def test_env_knobs_resolve(self):
+        kw = serve_knobs_from_env({
+            "GRAFT_SERVE_SPEC_K": " 4 ",
+            "GRAFT_SERVE_KV_WIRE": "fp8_e4m3:128",
+        })
+        assert kw["spec_k"] == 4
+        assert kw["kv_wire"] == "fp8_e4m3:128"
+        off = serve_knobs_from_env({})
+        assert off["spec_k"] == 0 and off["kv_wire"] is None
+
+    def test_env_round_trips_into_engine(self, params):
+        kw = serve_knobs_from_env({
+            "GRAFT_SERVE_SPEC_K": "4",
+            "GRAFT_SERVE_KV_WIRE": "fp8_e4m3:128",
+        })
+        eng = _engine(params, spec_k=kw["spec_k"], kv_wire=kw["kv_wire"])
+        assert eng.spec_k == 4
+        assert eng.kv_wire.name == "fp8_e4m3"
+        assert eng.kv_wire.block == 128
+
+    def test_tpu_config_twins_inject(self, monkeypatch):
+        monkeypatch.delenv("GRAFT_SERVE_SPEC_K", raising=False)
+        monkeypatch.delenv("GRAFT_SERVE_KV_WIRE", raising=False)
+        cfg = TPUConfig(serve_spec_k=4, serve_kv_wire="int8_block")
+        out = _serve_fastpath_overrides(cfg, {})
+        assert out == {"spec_k": 4, "kv_wire": "int8_block"}
+
+    def test_explicit_override_beats_config(self, monkeypatch):
+        monkeypatch.delenv("GRAFT_SERVE_SPEC_K", raising=False)
+        monkeypatch.delenv("GRAFT_SERVE_KV_WIRE", raising=False)
+        cfg = TPUConfig(serve_spec_k=4, serve_kv_wire="int8_block")
+        out = _serve_fastpath_overrides(
+            cfg, {"spec_k": 0, "kv_wire": None}
+        )
+        assert out == {"spec_k": 0, "kv_wire": None}
+
+    def test_env_beats_config(self, monkeypatch):
+        monkeypatch.setenv("GRAFT_SERVE_SPEC_K", "6")
+        monkeypatch.delenv("GRAFT_SERVE_KV_WIRE", raising=False)
+        cfg = TPUConfig(serve_spec_k=4, serve_kv_wire="int8_block")
+        out = _serve_fastpath_overrides(cfg, {})
+        # spec_k left to the env knob downstream; kv_wire injected
+        assert out == {"kv_wire": "int8_block"}
+
+
+class TestValidation:
+    def test_spec_requires_greedy(self, params):
+        with pytest.raises(ValueError, match="greedy"):
+            _engine(params, spec_k=4, temperature=0.7)
+
+    def test_generate_kv_wire_requires_paged(self, params):
+        model = GPT2(CFG, decode=True)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="paged"):
+            generate(
+                model, params, prompt, 2,
+                kv_layout="contiguous", kv_wire="int8_block",
+            )
+
+    def test_unknown_wire_spelling_rejected(self, params):
+        with pytest.raises(ValueError):
+            _engine(params, kv_wire="int9")
+
+
+class TestSpecRegressRule:
+    """Seeded-violation tests for the ``serve-spec-regress`` runtime
+    rule (same save/restore discipline as the recompile-rule tests)."""
+
+    def _reset(self, **kw):
+        saved = dict(runtime_stats)
+        runtime_stats.update({
+            "engines_built": 1, "steady_windows": 1,
+            "steady_recompiles": 0, "jit_entries_at_steady": 4,
+            "jit_entries_now": 4, "spec_enabled": 1, "spec_k": 4,
+            "spec_ticks": 20, "spec_proposed": 60, "spec_accepted": 40,
+            "spec_accept_rate": 40 / 60,
+        })
+        runtime_stats.update(kw)
+        return saved
+
+    def _findings(self):
+        report = run_rules(
+            AnalysisContext(platform="cpu"), planes=("runtime",),
+            ignore=frozenset(),
+        )
+        return [
+            f for f in report.findings if f.rule == "serve-spec-regress"
+        ]
+
+    def test_error_when_spec_grows_steady_set(self):
+        saved = self._reset(steady_recompiles=1, jit_entries_now=5)
+        try:
+            hits = self._findings()
+            assert len(hits) == 1
+            assert hits[0].severity is Severity.ERROR
+            assert "steady_recompiles=1" in hits[0].evidence
+        finally:
+            runtime_stats.clear()
+            runtime_stats.update(saved)
+
+    def test_silent_when_spec_disabled(self):
+        # a vanilla engine's steady growth belongs to the recompile
+        # rule, not this one
+        saved = self._reset(spec_enabled=0, steady_recompiles=2)
+        try:
+            assert not self._findings()
+        finally:
+            runtime_stats.clear()
+            runtime_stats.update(saved)
+
+    def test_warn_when_accept_rate_under_floor(self, monkeypatch):
+        monkeypatch.setenv("GRAFT_SPEC_ACCEPT_FLOOR", "0.5")
+        saved = self._reset(
+            spec_proposed=100, spec_accepted=20, spec_accept_rate=0.2,
+        )
+        try:
+            hits = self._findings()
+            assert len(hits) == 1
+            assert hits[0].severity is Severity.WARN
+            assert "floor=0.5" in hits[0].evidence
+        finally:
+            runtime_stats.clear()
+            runtime_stats.update(saved)
+
+    def test_silent_above_floor_or_floor_unset(self, monkeypatch):
+        monkeypatch.setenv("GRAFT_SPEC_ACCEPT_FLOOR", "0.5")
+        saved = self._reset()  # rate 0.667 > 0.5
+        try:
+            assert not self._findings()
+        finally:
+            runtime_stats.clear()
+            runtime_stats.update(saved)
+        monkeypatch.delenv("GRAFT_SPEC_ACCEPT_FLOOR")
+        saved = self._reset(spec_accept_rate=0.01)
+        try:
+            assert not self._findings()  # no floor provisioned, no WARN
+        finally:
+            runtime_stats.clear()
+            runtime_stats.update(saved)
