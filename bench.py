@@ -344,7 +344,7 @@ _ARM_ENVS = (  # envs that change WHICH arm is being measured
     "GRAFT_BENCH_SCAN_K", "GRAFT_BENCH_FEED", "GRAFT_BENCH_PREFETCH",
     "GRAFT_REMAT", "GRAFT_SCAN_LAYERS", "GRAFT_WIRE", "GRAFT_FP8",
     "GRAFT_BENCH_RECOVERY", "GRAFT_BENCH_SERVE",
-    "GRAFT_BENCH_SERVE_FLEET",
+    "GRAFT_BENCH_SERVE_FLEET", "GRAFT_BENCH_PLAN",
 )
 
 
@@ -802,6 +802,53 @@ def _serve_arm() -> None:
     _emit_error("serve arm: no serve_slo record in child output")
 
 
+def _plan_arm() -> None:
+    """Planner A/B arm (GRAFT_BENCH_PLAN=1): does the ranking hold up?
+
+    Runs ``benchmarks/plan_bench.py`` in a child on a small CPU mesh:
+    the real planner search (AOT memory + static prune), then a
+    stopwatch over every ranked survivor plus the default config. The
+    record publishes ``plan_rank_of_measured_best`` and
+    ``plan_predicted_vs_measured_ratio`` (headline value — the sentry
+    tracks it, so cost-model drift that survives calibration shows up
+    as a bench regression), plus the GRAFT_PLAN apply round-trip proof.
+    """
+    env = dict(os.environ)
+    env.setdefault("GRAFT_BENCH_PLATFORM", "cpu")
+    if env["GRAFT_BENCH_PLATFORM"] == "cpu":
+        env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONUNBUFFERED"] = "1"
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks", "plan_bench.py",
+    )
+    _status("plan arm: planner ranking vs measured A/B")
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], env=env, capture_output=True,
+            text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(script)),
+        )
+    except subprocess.TimeoutExpired:
+        _emit_error("plan arm: plan_bench.py hung >600s")
+        return
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "")[-500:]
+        _emit_error(f"plan arm: rc={proc.returncode}: {tail}")
+        return
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("metric") == "plan_ab":
+                _emit_result(json.dumps(rec))
+                return
+    _emit_error("plan arm: no plan_ab record in child output")
+
+
 def _serve_fleet_arm() -> None:
     """Fleet-failover arm (GRAFT_BENCH_SERVE_FLEET=1): the router's
     never-hang record.
@@ -945,6 +992,11 @@ def main() -> None:
         # the serving arm defaults to the pool-free CPU self-test; its
         # child owns warmup/steady bookkeeping and the graftcheck verdict
         _serve_arm()
+        return
+    if os.environ.get("GRAFT_BENCH_PLAN"):
+        # pool-free planner A/B: rank on the cost model, verify with a
+        # stopwatch on a small CPU mesh
+        _plan_arm()
         return
 
     # Hard guarantees: the alarm fires at the self-deadline; SIGTERM from a

@@ -58,6 +58,10 @@ STAGES = [
                     "terminal-state census (migrated/replayed/shed) and "
                     "router overhead under SIGKILL + graceful drain "
                     "(bench.py, GRAFT_BENCH_SERVE_FLEET=1)"),
+    ("plan", "auto-planner A/B: ranked survivors vs measured on a small "
+             "CPU mesh — plan_rank_of_measured_best, "
+             "plan_predicted_vs_measured_ratio, GRAFT_PLAN apply "
+             "round-trip (bench.py, GRAFT_BENCH_PLAN=1)"),
     ("fleet", "fleet observability: merged cross-host trace rollup "
               "(trace_summary.py per-host lanes) + perf-regression "
               "sentry vs the BENCH_* trajectory (regress.py)"),
@@ -136,6 +140,8 @@ ARM_KNOBS = {
     "serve_spec": "GRAFT_SERVE_SPEC_K=4 GRAFT_SERVE_KV_WIRE=int8_block",
     # fleet failover arm (robustness record, never a throughput winner)
     "serve_fleet": "GRAFT_BENCH_SERVE_FLEET=1",
+    # planner A/B arm (calibration record, never a throughput winner)
+    "plan": "GRAFT_BENCH_PLAN=1",
     # numerics plane arm (health record, never a throughput winner)
     "numerics": "GRAFT_NUMERICS=1 GRAFT_NUMERICS_ACTION=halt",
     # op-cost attribution arm (attribution record, never a winner)

@@ -1,0 +1,174 @@
+"""Planner A/B child (bench.py GRAFT_BENCH_PLAN=1 arm).
+
+Does the auto-planner's ranking survive contact with a stopwatch? On a
+small CPU mesh (1x2), run the real planner search (AOT memory + static
+prune included), then MEASURE every ranked survivor plus the current
+default configuration, and publish:
+
+- ``plan_rank_of_measured_best`` — where the measured-fastest arm sat
+  in the planner's ranking (1 = the planner was right; 0 = the
+  default won and the planner never ranked it),
+- ``plan_predicted_vs_measured_ratio`` — the top plan's predicted
+  step time over its measured step time (the regression sentry tracks
+  this; a drifting ratio means the cost model needs re-calibration),
+- ``plan_applied`` — the GRAFT_PLAN round-trip: the emitted plan.json
+  re-loaded through the env knob and applied onto a default TPUConfig,
+  proving the apply path reproduces the measured arm's
+  mesh/policy/remat/pp/wire fields exactly.
+
+Emits one JSON record (metric ``plan_ab``) on stdout; bench.py's
+parent scans for it and runs the regression sentry at publication.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+TOPOLOGY = os.environ.get("GRAFT_BENCH_PLAN_TOPOLOGY", "1x2")
+MODEL = os.environ.get("GRAFT_BENCH_PLAN_MODEL", "mlp")
+STEPS = int(os.environ.get("GRAFT_BENCH_PLAN_STEPS", "30"))
+WARMUP = int(os.environ.get("GRAFT_BENCH_PLAN_WARMUP", "5"))
+TOP_K = 3
+
+
+def _ensure_devices(n: int) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+def _measure(plan) -> float:
+    """Median-free mean step seconds over the steady window."""
+    from pytorch_distributedtraining_tpu.analyze.planner import build_step
+
+    import jax
+
+    step, state, batch = build_step(plan)
+    for _ in range(WARMUP):
+        state, _m = step(state, batch)
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, _m = step(state, batch)
+    jax.block_until_ready(state.params)
+    return (time.perf_counter() - t0) / STEPS
+
+
+def main() -> int:
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from pytorch_distributedtraining_tpu.analyze.planner import (
+        parse_topology,
+        search,
+    )
+
+    n = parse_topology(TOPOLOGY)
+    _ensure_devices(n)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from pytorch_distributedtraining_tpu.analyze.plan import (
+        Plan,
+        apply_plan_to_config,
+        load_plan,
+        write_plan,
+    )
+
+    # the A/B search space stays small on purpose: the CPU stopwatch can
+    # only discriminate configurations whose difference is structural
+    # (mesh/policy/pp), not quantizer micro-overheads
+    doc = search(
+        MODEL, TOPOLOGY,
+        top_k=TOP_K,
+        policies=("ddp", "zero1", "zero2"),
+        remats=("none",),
+        wires=(None,),
+        schedules=("gpipe", "1f1b"),
+        micro_factors=(2,),
+    )
+    ranked = [Plan.from_dict(r) for r in doc["ranked"]]
+    if not ranked:
+        print(json.dumps({"error": "planner found no feasible candidate"}))
+        return 1
+
+    # arms: every ranked survivor, plus the facade's default config
+    # (all-devices DDP) if the ranking didn't already include it
+    default = Plan(
+        model=MODEL, topology=TOPOLOGY, dp=n, policy="ddp",
+        batch=ranked[0].batch,
+    )
+    arms = list(ranked)
+    default_in_ranking = any(p.key() == default.key() for p in ranked)
+    if not default_in_ranking:
+        arms.append(default)
+
+    measured = []
+    for p in arms:
+        secs = _measure(p)
+        measured.append(
+            {
+                "rank": p.rank,  # None for the appended default
+                "config": {
+                    "dp": p.dp, "fsdp": p.fsdp, "pp": p.pp,
+                    "policy": p.policy, "remat": p.remat,
+                    "pp_schedule": p.pp_schedule if p.pp > 1 else "none",
+                    "wire": p.wire,
+                },
+                "predicted_s": (p.predicted or {}).get("total_s"),
+                "measured_s": secs,
+            }
+        )
+    best = min(measured, key=lambda a: a["measured_s"])
+    top = measured[0]
+    ratio = (
+        top["predicted_s"] / top["measured_s"]
+        if top["predicted_s"] and top["measured_s"]
+        else None
+    )
+
+    # GRAFT_PLAN round-trip: plan.json -> env knob -> load -> apply onto
+    # a default TPUConfig — must reproduce the top arm's fields exactly
+    from pytorch_distributedtraining_tpu.stoke.config import TPUConfig
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "plan.json")
+        write_plan(path, doc)
+        os.environ["GRAFT_PLAN"] = path
+        applied_plan = load_plan(os.environ["GRAFT_PLAN"])
+        cfg, conflicts = apply_plan_to_config(applied_plan, TPUConfig())
+    applied = {
+        "dp": cfg.dp, "fsdp": cfg.fsdp, "pp": cfg.pp,
+        "policy": applied_plan.policy,
+        "remat": cfg.remat if cfg.remat else "none",
+        "pp_schedule": cfg.pp_schedule if cfg.pp > 1 else "none",
+        "wire": cfg.wire,
+    }
+    rec = {
+        "metric": "plan_ab",
+        "value": ratio,
+        "unit": "predicted/measured",
+        "model": MODEL,
+        "topology": TOPOLOGY,
+        "steps": STEPS,
+        "plan_rank_of_measured_best": best["rank"] or 0,
+        "plan_predicted_vs_measured_ratio": ratio,
+        "arms": measured,
+        "plan_applied": applied,
+        "plan_applied_matches_top": applied == top["config"],
+        "plan_apply_conflicts": conflicts,
+        "planner_meta": {
+            k: doc["meta"][k]
+            for k in ("considered", "probes_used", "probed")
+        },
+    }
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

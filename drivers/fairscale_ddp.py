@@ -355,6 +355,15 @@ def main(argv=None):
                              "int8_block/fp8_e4m3/fp8_e5m2, optional "
                              ":BLOCK suffix (env twin $GRAFT_WIRE; "
                              "default: f32 collectives)")
+    parser.add_argument("--plan", type=str,
+                        default=os.environ.get("GRAFT_PLAN"),
+                        help="auto-planner plan.json (path or inline JSON): "
+                             "threads the top-ranked plan's remat/wire "
+                             "through their env twins when not set "
+                             "explicitly; this driver's engine is fixed "
+                             "ZeRO2, so a plan asking for another "
+                             "policy/mesh logs the conflict and keeps the "
+                             "engine (env twin $GRAFT_PLAN)")
     parser.add_argument("--analyze", type=str, nargs="?", const="error",
                         default=os.environ.get("GRAFT_ANALYZE"),
                         choices=["warn", "error", "off"],
@@ -416,6 +425,33 @@ def main(argv=None):
     if opt.numerics:
         os.environ["GRAFT_NUMERICS"] = "1"
         os.environ["GRAFT_NUMERICS_ACTION"] = opt.numerics
+
+    if opt.plan:
+        # this driver hand-builds its ZeRO2 engine, so only the plan's
+        # step-level knobs (remat/wire) can apply — thread them through
+        # the env twins the train() path already resolves, and say out
+        # loud which plan fields the fixed engine overrides
+        from pytorch_distributedtraining_tpu.analyze.plan import load_plan
+
+        plan = load_plan(opt.plan)
+        want = plan.config_fields()
+        if opt.remat is None and not os.environ.get("GRAFT_REMAT"):
+            if want["remat"]:
+                os.environ["GRAFT_REMAT"] = str(want["remat"])
+        elif str(want["remat"] or "none") != str(
+            opt.remat or os.environ.get("GRAFT_REMAT") or "none"
+        ):
+            print(f"===> plan conflict: explicit remat wins over the "
+                  f"plan's {want['remat']!r}")
+        if opt.wire is None and not os.environ.get("GRAFT_WIRE"):
+            if want["wire"]:
+                os.environ["GRAFT_WIRE"] = want["wire"]
+        elif (opt.wire or os.environ.get("GRAFT_WIRE")) != want["wire"]:
+            print(f"===> plan conflict: explicit wire wins over the "
+                  f"plan's {want['wire']!r}")
+        if plan.policy != "zero2" or plan.pp > 1 or plan.dp > 1:
+            print(f"===> plan conflict: this driver's fixed ZeRO2 mesh "
+                  f"overrides the plan's {plan.describe()!r}")
 
     if opt.opcost:
         os.environ["GRAFT_OPCOST"] = "1"

@@ -249,6 +249,14 @@ def build_parser():
                              "config field (GPT-2/ViT; env twin $GRAFT_FP8"
                              "). SwinIR has no fp8 tagging — the facade "
                              "warns and keeps the model dtype")
+    parser.add_argument("--plan", type=str,
+                        default=os.environ.get("GRAFT_PLAN"),
+                        help="apply an auto-planner plan.json (path or "
+                             "inline JSON): its top-ranked configuration "
+                             "fills every mesh/policy/remat/pp/wire knob "
+                             "still at its default; explicit flags above "
+                             "win with a logged conflict (env twin "
+                             "$GRAFT_PLAN; see docs/PLANNER.md)")
     parser.add_argument("--analyze", type=str, nargs="?", const="error",
                         default=os.environ.get("GRAFT_ANALYZE"),
                         choices=["warn", "error", "off"],
@@ -344,6 +352,12 @@ def main(argv=None):
         os.environ["GRAFT_PP_SCHEDULE"] = opt.pp_schedule
         print(f"===> pp={opt.pp} schedule={opt.pp_schedule} "
               "(mesh axis only on this driver; see --help)")
+
+    # --plan threads the auto-planner artifact through its env twin: the
+    # facade loads it and fills every knob not explicitly set here
+    if opt.plan:
+        os.environ["GRAFT_PLAN"] = opt.plan
+        print(f"===> auto-planner plan={opt.plan}")
 
     # --analyze threads graftcheck through its env twin: the facade runs
     # the analyzer once at first compile of the fused step

@@ -29,10 +29,28 @@ from .findings import (
     analyze_mode,
     ignored_rules,
 )
+from .plan import (
+    ENV_PLAN,
+    Plan,
+    apply_plan_to_config,
+    load_plan,
+    plan_doc,
+    write_plan,
+)
+from .planner import enumerate_candidates, rank_candidates, search
 from .registry import PLANES, RULES, AnalysisContext, Rule, rule, run_rules
 from .runner import analyze_step, build_context, rule_catalog, step_jaxpr
 
 __all__ = [
+    "ENV_PLAN",
+    "Plan",
+    "apply_plan_to_config",
+    "load_plan",
+    "plan_doc",
+    "write_plan",
+    "enumerate_candidates",
+    "rank_candidates",
+    "search",
     "Finding",
     "Report",
     "Severity",

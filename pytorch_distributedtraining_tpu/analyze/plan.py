@@ -1,0 +1,326 @@
+"""The Plan artifact: one ranked configuration the auto-planner emitted.
+
+A :class:`Plan` is the planner's unit of output — one point in the
+(mesh × policy × remat × pp-schedule × microbatch × wire) search space,
+plus everything the ranking decided about it: the calibrated cost
+prediction, the AOT memory-probe result, and the prune reason when it
+was disqualified. ``plan.json`` (written by
+``python -m pytorch_distributedtraining_tpu.analyze.plan``) is a doc of
+these, ranked; ``$GRAFT_PLAN=<path|inline-json>`` feeds the top entry
+back into the Stoke facade as TPUConfig fields.
+
+Apply-path contract (mirrors every other GRAFT_* env twin, inverted):
+the plan is the *weakest* voice — an explicit TPUConfig field or a set
+env twin ($GRAFT_WIRE, $GRAFT_PP, ...) always wins over the plan, and
+the disagreement is logged as a conflict so a run never silently
+ignores either side.
+
+This module is stdlib-only on purpose: the graftcheck runtime plane
+reads :data:`runtime_stats` via ``sys.modules`` (never an import), and
+``observe/opcost.py`` marks the active plan stale here when
+``calibrate()`` sees drift past tolerance — both must work in processes
+that never import jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from dataclasses import dataclass, field
+
+ENV_PLAN = "GRAFT_PLAN"
+
+# TPUConfig-field -> env twin that can override it: a set twin makes the
+# knob "explicit" for plan-apply precedence even when the config field
+# still holds its default
+ENV_TWINS = {
+    "remat": "GRAFT_REMAT",
+    "wire": "GRAFT_WIRE",
+    "pp": "GRAFT_PP",
+    "pp_schedule": "GRAFT_PP_SCHEDULE",
+    "pp_micro": "GRAFT_PP_MICRO",
+}
+
+# plan.policy -> the facade's ctor engine flags (policy_from_flags)
+_POLICY_FLAGS = {
+    "ddp": {},
+    "zero1": {"fairscale_oss": True},
+    "zero2": {"fairscale_oss": True, "fairscale_sddp": True},
+    "zero3": {
+        "fairscale_oss": True, "fairscale_sddp": True, "fairscale_fsdp": True,
+    },
+}
+
+# read by analyze/runtime_rules.py (plan-stale, plan-infeasible) via
+# sys.modules — never imported there; written by the facade apply path
+# (record_applied) and by observe/opcost.calibrate's drift hook
+# (mark_stale)
+runtime_stats: dict = {
+    "active_plan": None,    # to_dict() of the applied plan
+    "applied_at": None,     # wall-clock stamp of the apply
+    "stale": False,         # calibration drifted past tol after ranking
+    "stale_reason": None,
+    "infeasible": None,     # reason the plan fails its own prune here
+    "conflicts": [],        # knobs where an explicit value beat the plan
+}
+
+
+def reset() -> None:
+    """Restore module gauges to import-time state (process-global on
+    purpose — consumers read them via ``sys.modules``)."""
+    runtime_stats.update(
+        active_plan=None, applied_at=None, stale=False,
+        stale_reason=None, infeasible=None, conflicts=[],
+    )
+
+
+@dataclass
+class Plan:
+    """One candidate configuration plus what the planner decided about it."""
+
+    model: str = "mlp"
+    topology: str = "1x1"   # the target the search ran against, e.g. "2x4"
+    dp: int = 1
+    fsdp: int = 1
+    pp: int = 1
+    policy: str = "ddp"     # ddp | zero1 | zero2 | zero3
+    remat: str = "none"     # none | full | dots | names | offload
+    pp_schedule: str = "none"  # gpipe | 1f1b | interleaved ("none" at pp=1)
+    pp_micro: int = 0
+    pp_v: int = 1           # virtual stages per rank (interleaved >= 2)
+    wire: str | None = None
+    batch: int = 16         # global batch the costs were modeled at
+    # filled by the planner:
+    predicted: dict = field(default_factory=dict)
+    feasible: bool | None = None  # None = never AOT-probed
+    prune_reason: str | None = None
+    peak_bytes: int | None = None  # AOT compiled-memory peak per device
+    max_batch: int | None = None   # tune_batch_size result, when tuned
+    rank: int | None = None
+    calibration: dict = field(default_factory=dict)  # name -> ratio used
+
+    @property
+    def devices(self) -> int:
+        return self.dp * self.fsdp * self.pp
+
+    def key(self) -> tuple:
+        """Identity in the search space (excludes ranking outputs)."""
+        return (
+            self.dp, self.fsdp, self.pp, self.policy, self.remat,
+            self.pp_schedule, self.pp_micro, self.pp_v, self.wire,
+        )
+
+    def describe(self) -> str:
+        mesh = ",".join(
+            f"{k}{v}" for k, v in
+            (("dp", self.dp), ("fsdp", self.fsdp), ("pp", self.pp))
+            if v > 1
+        ) or "dp1"
+        bits = [mesh, self.policy, f"remat={self.remat}"]
+        if self.pp > 1:
+            bits.append(f"{self.pp_schedule}/m{self.pp_micro}")
+        if self.wire:
+            bits.append(f"wire={self.wire}")
+        return " ".join(bits)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Plan":
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})
+
+    def config_fields(self) -> dict:
+        """The TPUConfig field values this plan pins (policy rides
+        separately through :meth:`policy_flags` — it is a ctor flag, not
+        a TPUConfig field)."""
+        out = {
+            "dp": self.dp,
+            "fsdp": self.fsdp,
+            "pp": self.pp,
+            "remat": False if self.remat in ("none", "", None) else self.remat,
+            "wire": self.wire or None,
+        }
+        if self.pp > 1:
+            out["pp_schedule"] = self.pp_schedule
+            out["pp_micro"] = self.pp_micro
+        return out
+
+    def policy_flags(self) -> dict:
+        """Facade ctor flags (``fairscale_oss``/``_sddp``/``_fsdp``)
+        that select this plan's sharding policy."""
+        try:
+            return dict(_POLICY_FLAGS[self.policy])
+        except KeyError:
+            raise ValueError(
+                f"plan policy must be one of {sorted(_POLICY_FLAGS)}, "
+                f"got {self.policy!r}"
+            ) from None
+
+
+# -- plan.json round-trip ------------------------------------------------
+
+
+def plan_doc(ranked, pruned=(), meta=None) -> dict:
+    """Assemble the ``plan.json`` document: ranked survivors first (rank
+    stamped 1-based), pruned candidates with their reasons after."""
+    doc_ranked = []
+    for i, p in enumerate(ranked):
+        p.rank = i + 1
+        doc_ranked.append(p.to_dict())
+    return {
+        "version": 1,
+        "meta": dict(meta or {}),
+        "ranked": doc_ranked,
+        "pruned": [p.to_dict() for p in pruned],
+    }
+
+
+def write_plan(path: str, doc: dict) -> str:
+    """Atomic write (tmp + rename), same contract as calibration.json."""
+    import os
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def load_plan(spec: str) -> Plan:
+    """Resolve ``$GRAFT_PLAN``: a path to plan.json, or inline JSON.
+
+    Accepts the full planner doc (takes the top-ranked entry), a bare
+    plan dict, or inline JSON of either. Raises ValueError on an empty
+    ranking or unparseable input; OSError on an unreadable path.
+    """
+    text = spec.strip()
+    if not text.startswith("{"):
+        with open(spec, encoding="utf-8") as fh:
+            text = fh.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"GRAFT_PLAN is neither a path nor JSON: {e}") from None
+    if not isinstance(doc, dict):
+        raise ValueError(f"plan doc must be a JSON object, got {type(doc).__name__}")
+    if "ranked" in doc:
+        if not doc["ranked"]:
+            raise ValueError(
+                "plan doc has an empty ranking — the planner found no "
+                "feasible candidate; re-run with a larger --budget-gb or "
+                "a wider search"
+            )
+        doc = doc["ranked"][0]
+    return Plan.from_dict(doc)
+
+
+# -- facade apply path ---------------------------------------------------
+
+
+def apply_plan_to_config(plan: Plan, cfg, *, env=None):
+    """Merge a plan's fields into a TPUConfig-like dataclass.
+
+    Precedence: an explicit knob — a config field that differs from its
+    dataclass default, or a set env twin — WINS over the plan, and the
+    disagreement lands in the returned conflict list (the caller logs
+    it). Everything else adopts the plan's value. Returns
+    ``(new_cfg, conflicts)`` where each conflict is
+    ``{"knob", "explicit", "plan"}``.
+    """
+    import os
+
+    env = os.environ if env is None else env
+    fields = {f.name: f for f in dataclasses.fields(cfg)}
+    updates, conflicts = {}, []
+    for name, want in plan.config_fields().items():
+        f = fields.get(name)
+        if f is None:
+            continue
+        current = getattr(cfg, name)
+        default = f.default
+        twin = ENV_TWINS.get(name)
+        env_val = env.get(twin) if twin else None
+        explicit = (
+            current != default
+            if default is not dataclasses.MISSING
+            else False
+        ) or env_val is not None
+        if explicit:
+            effective = env_val if env_val is not None else current
+            if str(effective) != str(want):
+                conflicts.append(
+                    {"knob": name, "explicit": effective, "plan": want}
+                )
+            continue
+        updates[name] = want
+    return dataclasses.replace(cfg, **updates), conflicts
+
+
+def record_applied(
+    plan: Plan,
+    *,
+    device_count: int | None = None,
+    budget_bytes: int | None = None,
+    conflicts=(),
+    now: float | None = None,
+) -> str | None:
+    """Publish the applied plan into :data:`runtime_stats` and re-check
+    the plan's own prunes against THIS host (the ``plan-infeasible``
+    rule's evidence). Returns the infeasibility reason, or None."""
+    reason = None
+    if plan.feasible is False:
+        reason = (
+            f"the applied plan was itself pruned at rank time "
+            f"({plan.prune_reason})"
+        )
+    elif device_count is not None and plan.devices != device_count:
+        reason = (
+            f"plan targets {plan.devices} devices (topology "
+            f"{plan.topology!r}) but this host exposes {device_count}"
+        )
+    elif (
+        budget_bytes is not None
+        and plan.peak_bytes is not None
+        and plan.peak_bytes > budget_bytes
+    ):
+        reason = (
+            f"plan's compiled peak ({plan.peak_bytes} B/device) exceeds "
+            f"this device's memory budget ({budget_bytes} B)"
+        )
+    runtime_stats.update(
+        active_plan=plan.to_dict(),
+        applied_at=time.time() if now is None else now,
+        stale=False,
+        stale_reason=None,
+        infeasible=reason,
+        conflicts=list(conflicts),
+    )
+    return reason
+
+
+def mark_stale(reason: str) -> bool:
+    """Calibration drifted past tolerance after the active plan was
+    ranked: flag it so the next planner invocation re-ranks. Called by
+    ``observe/opcost.calibrate`` via ``sys.modules``. No-op (False)
+    when no plan is active."""
+    if runtime_stats.get("active_plan") is None:
+        return False
+    runtime_stats["stale"] = True
+    runtime_stats["stale_reason"] = reason
+    return True
+
+
+def main(argv=None) -> int:
+    from .planner import main as planner_main
+
+    return planner_main(argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

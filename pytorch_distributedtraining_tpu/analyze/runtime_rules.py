@@ -577,6 +577,78 @@ def calibration_drift(ctx):
         )
 
 
+def _plan_stats():
+    """analyze.plan's gauges via sys.modules — same no-import contract
+    as every other runtime source (plan.py is stdlib-only, but going
+    through its package spelling keeps this plane import-free)."""
+    mod = sys.modules.get("pytorch_distributedtraining_tpu.analyze.plan")
+    return getattr(mod, "runtime_stats", None) if mod else None
+
+
+@rule(
+    "plan-stale",
+    "runtime",
+    "calibration drifted past tolerance after the active plan was ranked",
+)
+def plan_stale(ctx):
+    stats = _plan_stats()
+    if not stats or not stats.get("stale") or not stats.get("active_plan"):
+        return
+    plan = stats["active_plan"]
+    yield Finding(
+        "plan-stale",
+        Severity.WARN,
+        "runtime:plan",
+        f"the active GRAFT_PLAN (rank {plan.get('rank')}, "
+        f"{plan.get('policy')} on {plan.get('topology')}) was ranked "
+        "with calibration ratios that have since drifted past tolerance "
+        f"({stats.get('stale_reason')}). The plan still runs, but its "
+        "ordering argument is gone — the runner-up may now be faster. "
+        "Re-run the planner (python -m "
+        "pytorch_distributedtraining_tpu.analyze.plan) against the fresh "
+        "calibration.json; it re-ranks automatically",
+        evidence=(
+            f"rank={plan.get('rank')} key={plan.get('policy')}/"
+            f"remat={plan.get('remat')}/pp={plan.get('pp')} "
+            f"stale_reason={stats.get('stale_reason')!r} "
+            f"applied_at={stats.get('applied_at')}"
+        ),
+    )
+
+
+@rule(
+    "plan-infeasible",
+    "runtime",
+    "the applied GRAFT_PLAN fails its own memory/static prune here",
+)
+def plan_infeasible(ctx):
+    stats = _plan_stats()
+    if not stats or not stats.get("active_plan"):
+        return
+    reason = stats.get("infeasible")
+    if not reason:
+        return
+    plan = stats["active_plan"]
+    yield Finding(
+        "plan-infeasible",
+        Severity.ERROR,
+        "runtime:plan",
+        f"the applied GRAFT_PLAN does not survive its own prune on this "
+        f"topology: {reason}. The plan was ranked for "
+        f"{plan.get('topology')!r} ({plan.get('dp')}x{plan.get('fsdp')}"
+        f"x{plan.get('pp')} devices) — applying it here either OOMs or "
+        "silently trains a different layout than the one the ranking "
+        "argued for. Re-plan for THIS topology instead of reusing the "
+        "artifact",
+        evidence=(
+            f"reason={reason!r} plan_devices={plan.get('dp', 1)}*"
+            f"{plan.get('fsdp', 1)}*{plan.get('pp', 1)} "
+            f"peak_bytes={plan.get('peak_bytes')} "
+            f"feasible={plan.get('feasible')}"
+        ),
+    )
+
+
 @rule(
     "bench-regression",
     "runtime",

@@ -170,6 +170,15 @@ def record_hbm_stats(device=None, projected_peak_bytes: int | None = None) -> di
     return dict(runtime_stats)
 
 
+class NoMemoryBudget(ValueError):
+    """Strict refusal: no device memory budget and none was passed.
+
+    A ValueError subclass (the old contract) with a name callers can
+    dispatch on — `analyze.planner` turns it into a candidate prune
+    reason (``no-hbm-budget``) instead of a crashed search.
+    """
+
+
 def tune_batch_size(
     peak_bytes_fn: Callable[[int], int | None],
     *,
@@ -177,6 +186,7 @@ def tune_batch_size(
     start: int = 1,
     max_batch: int = 4096,
     safety: float = 0.9,
+    cache: dict | None = None,
 ) -> int:
     """Largest per-device batch whose PROJECTED peak fits the HBM budget.
 
@@ -187,6 +197,11 @@ def tune_batch_size(
     binary-refines between the last fit and first overflow. Compiles
     O(log max_batch) candidates but never RUNS a step, so mistuned
     candidates cost compile time, not an OOM crash.
+
+    ``cache`` (batch -> peak bytes) memoizes probes so a caller holding a
+    pre-built lower/compile closure — the planner probes many candidates
+    against the same step — never re-lowers a batch it has already paid
+    for, within this call or across calls sharing the dict.
     """
     if budget_bytes is None:
         # strict mode (fallback=None): tuning against "all of host RAM"
@@ -194,14 +209,19 @@ def tune_batch_size(
         # the never-guess contract and make the caller pass a budget
         budget_bytes = device_hbm_budget(fallback=None)
     if budget_bytes is None:
-        raise ValueError(
+        raise NoMemoryBudget(
             "no device memory budget: pass budget_bytes= explicitly "
             "(device.memory_stats() is unavailable on this backend)"
         )
     limit = budget_bytes * safety
+    probed = cache if cache is not None else {}
 
     def fits(b: int) -> bool | None:
-        peak = peak_bytes_fn(b)
+        if b in probed:
+            peak = probed[b]
+        else:
+            peak = peak_bytes_fn(b)
+            probed[b] = peak
         return None if peak is None else peak <= limit
 
     first = fits(start)

@@ -404,7 +404,38 @@ def calibrate(models: dict, previous: dict | None = None) -> dict:
     runtime_stats["calibration"] = out
     for name, row in out.items():
         rolling_gauges[f"calibration_ratio_{name}"] = row["ratio"]
+    _mark_plan_stale_on_drift(out)
     return out
+
+
+def _mark_plan_stale_on_drift(calibration: dict) -> None:
+    """Close the planner's control loop: drift past tolerance means the
+    ratios the active GRAFT_PLAN was ranked with no longer describe this
+    system, so flag the plan stale (analyze.plan.runtime_stats — read
+    via sys.modules, same no-import contract the rules use) and the next
+    planner invocation re-ranks against the fresh calibration."""
+    import sys as _sys
+
+    tol_env = os.environ.get("GRAFT_CALIB_DRIFT_TOL", "")
+    try:
+        tol = float(tol_env) if tol_env else 0.5
+    except ValueError:
+        tol = 0.5
+    drifted = sorted(
+        f"{name}:{row['drift']:+.3f}"
+        for name, row in calibration.items()
+        if row.get("drift") is not None and abs(row["drift"]) > tol
+    )
+    if not drifted:
+        return
+    plan_mod = _sys.modules.get(
+        "pytorch_distributedtraining_tpu.analyze.plan"
+    )
+    if plan_mod is None:
+        return
+    plan_mod.mark_stale(
+        f"calibration drift past tolerance {tol}: {', '.join(drifted)}"
+    )
 
 
 def write_calibration(path: str, calibration: dict, meta: dict | None = None) -> str:

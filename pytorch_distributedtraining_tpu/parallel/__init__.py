@@ -30,7 +30,7 @@ from .remat import (
 )
 from .spec import leaf_spec, tree_specs, shard_axis
 from .state import TrainState, create_train_state
-from .step import TrainStep, EvalStep, MultiStep, tune_multi_step_k
+from .step import CostSurface, TrainStep, EvalStep, MultiStep, tune_multi_step_k
 from .compressed import (
     WIRE_FORMATS,
     CompressedGradStep,
@@ -70,6 +70,7 @@ __all__ = [
     "shard_axis",
     "TrainState",
     "create_train_state",
+    "CostSurface",
     "TrainStep",
     "EvalStep",
     "MultiStep",

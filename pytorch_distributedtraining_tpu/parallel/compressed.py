@@ -396,6 +396,26 @@ class CompressedGradStep:
             "wire_fraction_quantized": (quantized / total) if total else 0.0,
         }
 
+    def comm_cost(self, params) -> dict:
+        """`CostSurface` view of :meth:`wire_cost` — the unified keys the
+        planner consumes (`TrainStep.comm_cost` is the f32 twin). The
+        collective is what the quantized hop replaces: reduce-scatter
+        when the ZeRO-2 row layout scatters, all-reduce otherwise."""
+        wc = self.wire_cost(params)
+        size = int(self.mesh.shape.get(self.axis_name, 1))
+        if self.ici_axis:
+            size *= int(self.mesh.shape.get(self.ici_axis, 1))
+        scattered = self.ici_axis is None and bool(self.policy.shard_grads)
+        return {
+            "collective": "reduce-scatter" if scattered else "all-reduce",
+            "fp32_bytes": wc["fp32_bytes"],
+            "wire_bytes": wc["wire_bytes"],
+            "wire_format": wc["wire_format"],
+            "wire_fraction_quantized": wc["wire_fraction_quantized"],
+            "axis": self.axis_name,
+            "axis_size": size,
+        }
+
     def init_residuals(self, params):
         """Zero per-shard error-feedback residuals, leading mesh axes
         ``[dp(, fsdp)]`` sharded so each shard owns its own residual.

@@ -949,6 +949,51 @@ class PipelineStep:
     def bubble_fraction(self) -> float:
         return self.schedule.bubble_fraction
 
+    def comm_cost(self, params) -> dict:
+        """`CostSurface` twin of ``TrainStep.comm_cost`` for the pipe.
+
+        Stage grads never cross stages (pinned P("pp")), so each pp
+        shard reduces only its 1/pp slice of the stage params over the
+        data axis; non-stage (embed/head) params pay the full-size hop.
+        Same convention otherwise: reduce-scatter n, all-reduce 2n,
+        ``min_shard_size`` floors stay at the all-reduce rate.
+        """
+        from .spec import leaf_spec, shard_axis
+
+        ax = shard_axis(self.mesh)
+        size = int(self.mesh.shape.get(ax, 1)) if ax else 1
+        pp = int(self.mesh.shape.get("pp", 1))
+        if ax is None or size <= 1:
+            return {
+                "collective": None,
+                "fp32_bytes": 0,
+                "wire_bytes": 0,
+                "wire_format": None,
+                "axis": None,
+                "axis_size": 1,
+            }
+        rs = bool(self.policy.shard_grads)
+        total = 0
+        for key, sub in params.items():
+            per_stage = pp if (key == self.stages_key and pp > 1) else 1
+            for p in jax.tree.leaves(sub):
+                n = 1
+                for s in p.shape:
+                    n *= int(s)
+                scattered = rs and leaf_spec(
+                    p.shape, ax, size, self.policy.min_shard_size
+                ) != P()
+                hops = 1 if scattered else 2
+                total += hops * (n // per_stage) * 4
+        return {
+            "collective": "reduce-scatter" if rs else "all-reduce",
+            "fp32_bytes": int(total),
+            "wire_bytes": int(total),
+            "wire_format": None,
+            "axis": ax,
+            "axis_size": size,
+        }
+
     def _step(self, state, batch, lr_factor):
         import optax
 

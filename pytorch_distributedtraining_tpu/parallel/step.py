@@ -26,7 +26,7 @@ warns if handed one).
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Protocol, runtime_checkable
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +42,23 @@ from .policy import Policy
 from .remat import apply_remat
 from .spec import constrain, stream_to_device
 from .state import TrainState
+
+
+@runtime_checkable
+class CostSurface(Protocol):
+    """The analytic cost contract every plannable step class exposes.
+
+    ``comm_cost(params)`` returns at least ``{"collective",
+    "fp32_bytes", "wire_bytes", "wire_format", "axis", "axis_size"}``
+    with the shared hop convention (reduce-scatter moves n bytes per
+    shard, all-reduce 2n); ``wire_bytes`` is what actually crosses the
+    wire after any grad compression (== ``fp32_bytes`` on the f32
+    wire). `TrainStep`, `CompressedGradStep`, and `PipelineStep` all
+    satisfy it, so `analyze.planner` can rank any of them off one
+    surface.
+    """
+
+    def comm_cost(self, params) -> dict: ...
 
 
 def _split_microbatches(batch, n: int):
@@ -472,6 +489,8 @@ class TrainStep:
             return {
                 "collective": None,
                 "fp32_bytes": 0,
+                "wire_bytes": 0,
+                "wire_format": None,
                 "axis": None,
                 "axis_size": 1,
             }
@@ -489,6 +508,9 @@ class TrainStep:
         return {
             "collective": "reduce-scatter" if rs else "all-reduce",
             "fp32_bytes": int(total),
+            # f32 wire: on-wire bytes == fp32 bytes, no quantized format
+            "wire_bytes": int(total),
+            "wire_format": None,
             "axis": ax,
             "axis_size": size,
         }

@@ -139,6 +139,12 @@ class TPUConfig:
     # precedence as GRAFT_WIRE).
     serve_spec_k: int = 0
     serve_kv_wire: str | None = None
+    # Auto-planner artifact (analyze/planner.py): path to a plan.json (or
+    # inline JSON) whose top-ranked configuration fills every knob above
+    # that is still at its default — an explicit field or a set env twin
+    # always beats the plan, with the conflict logged. Env twin:
+    # $GRAFT_PLAN (env wins). See docs/PLANNER.md.
+    plan: str | None = None
 
 
 @dataclass

@@ -550,6 +550,30 @@ class Stoke:
         self.oss_config = self._find_config(FairscaleOSSConfig) or FairscaleOSSConfig()
         self.tpu_config = self._find_config(TPUConfig) or TPUConfig()
         ds_config = self._find_config(DeepspeedConfig)
+        # GRAFT_PLAN (env > TPUConfig.plan): adopt the auto-planner's
+        # top-ranked configuration as the *weakest* voice — any explicit
+        # TPUConfig field or set env twin wins, with the disagreement
+        # logged so neither side is silently ignored (docs/PLANNER.md)
+        self._plan = None
+        self._plan_conflicts: list = []
+        plan_spec = os.environ.get("GRAFT_PLAN") or self.tpu_config.plan
+        if plan_spec:
+            from ..analyze import plan as _plan_mod
+
+            self._plan = _plan_mod.load_plan(plan_spec)
+            self.tpu_config, self._plan_conflicts = (
+                _plan_mod.apply_plan_to_config(self._plan, self.tpu_config)
+            )
+            if self._plan_conflicts:
+                import warnings
+
+                for c in self._plan_conflicts:
+                    warnings.warn(
+                        f"GRAFT_PLAN conflict on {c['knob']!r}: explicit "
+                        f"{c['explicit']!r} wins over the plan's "
+                        f"{c['plan']!r}",
+                        stacklevel=2,
+                    )
         # low-precision knobs (env > TPUConfig): quantized gradient wire
         # and the fp8 matmul mode for models that implement it
         self.wire = _wire_from_env(self.tpu_config)
@@ -628,6 +652,34 @@ class Stoke:
             fairscale_oss = fairscale_oss or stage >= 1
             fairscale_sddp = fairscale_sddp or stage >= 2
             fairscale_fsdp = fairscale_fsdp or stage >= 3
+        if self._plan is not None:
+            # plan policy rides the ctor engine flags; same precedence as
+            # the config fields — explicit flags (ctor or ds stage) win
+            want = self._plan.policy_flags()
+            have = (fairscale_oss, fairscale_sddp, fairscale_fsdp)
+            if not any(have):
+                fairscale_oss = want.get("fairscale_oss", False)
+                fairscale_sddp = want.get("fairscale_sddp", False)
+                fairscale_fsdp = want.get("fairscale_fsdp", False)
+            elif have != (
+                want.get("fairscale_oss", False),
+                want.get("fairscale_sddp", False),
+                want.get("fairscale_fsdp", False),
+            ):
+                import warnings
+
+                conflict = {
+                    "knob": "policy",
+                    "explicit": f"oss={have[0]},sddp={have[1]},fsdp={have[2]}",
+                    "plan": self._plan.policy,
+                }
+                self._plan_conflicts.append(conflict)
+                warnings.warn(
+                    f"GRAFT_PLAN conflict on 'policy': explicit engine "
+                    f"flags ({conflict['explicit']}) win over the plan's "
+                    f"{self._plan.policy!r}",
+                    stacklevel=2,
+                )
         # DeepSpeed/Fairscale offload knobs -> optimizer state in host memory
         fsdp_config = self._find_config(FairscaleFSDPConfig)
         offload_opt = bool(fsdp_config is not None and fsdp_config.cpu_offload)
@@ -702,6 +754,26 @@ class Stoke:
             )
         else:
             self.mesh = make_mesh(MeshSpec.zero() if zero else MeshSpec.ddp())
+        if self._plan is not None:
+            # publish the applied plan into analyze.plan.runtime_stats and
+            # re-check its own prunes against THIS host — the
+            # plan-infeasible runtime rule fires from what lands here
+            from ..analyze import plan as _plan_mod
+            from ..observe.memory import device_hbm_budget
+
+            reason = _plan_mod.record_applied(
+                self._plan,
+                device_count=jax.device_count(),
+                budget_bytes=device_hbm_budget(),
+                conflicts=self._plan_conflicts,
+            )
+            if reason:
+                import warnings
+
+                warnings.warn(
+                    f"GRAFT_PLAN is infeasible on this topology: {reason}",
+                    stacklevel=2,
+                )
 
         # -- precision -----------------------------------------------------
         fp16 = fp16.value if isinstance(fp16, FP16Options) else fp16

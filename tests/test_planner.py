@@ -1,0 +1,603 @@
+"""Auto-planner tests (ISSUE 18): enumeration completeness, the
+memory/static pruning truth table, calibration-corrected ranking (a
+seeded calibration.json flips the winner), the GRAFT_PLAN facade
+round-trip with explicit-knob precedence, CLI exit codes, the
+plan-stale / plan-infeasible runtime rules, and the
+drift -> stale -> re-rank control loop with a fake clock."""
+
+import dataclasses
+import json
+import os
+import sys
+
+import pytest
+
+from pytorch_distributedtraining_tpu.analyze import plan as plan_mod
+from pytorch_distributedtraining_tpu.analyze import planner
+from pytorch_distributedtraining_tpu.analyze.plan import (
+    Plan,
+    apply_plan_to_config,
+    load_plan,
+    plan_doc,
+    record_applied,
+    write_plan,
+)
+from pytorch_distributedtraining_tpu.analyze.planner import (
+    analytic_bubble,
+    enumerate_candidates,
+    factorizations,
+    parse_topology,
+    rank_candidates,
+    search,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan_state(monkeypatch):
+    plan_mod.reset()
+    monkeypatch.delenv("GRAFT_PLAN", raising=False)
+    monkeypatch.delenv("GRAFT_CALIB_DRIFT_TOL", raising=False)
+    monkeypatch.delenv("GRAFT_PEAK_FLOPS", raising=False)
+    yield
+    plan_mod.reset()
+    # the drift tests run the real opcost.calibrate, which publishes
+    # calibration_ratio_* gauges other suites assert against
+    opcost = sys.modules.get("pytorch_distributedtraining_tpu.observe.opcost")
+    if opcost is not None:
+        opcost.reset()
+
+
+# -- enumeration ---------------------------------------------------------
+
+
+class TestEnumeration:
+    def test_parse_topology(self):
+        assert parse_topology("2x4") == 8
+        assert parse_topology("1x8") == 8
+        assert parse_topology("8") == 8
+        with pytest.raises(ValueError):
+            parse_topology("2x")
+        with pytest.raises(ValueError):
+            parse_topology("0")
+
+    def test_factorizations_complete(self):
+        facs = factorizations(4)
+        assert set(facs) == {
+            (4, 1, 1), (2, 2, 1), (1, 4, 1),
+            (2, 1, 2), (1, 2, 2), (1, 1, 4),
+        }
+        # dp-major: the pure data-parallel spelling enumerates first
+        assert facs[0] == (4, 1, 1)
+        for dp, fsdp, pp in factorizations(12):
+            assert dp * fsdp * pp == 12
+
+    def test_enumeration_counts_and_keys(self):
+        cands = enumerate_candidates(
+            "mlp", "1x2", wires=(None,), remats=("none",),
+        )
+        # 3 factorizations x 4 policies; pp=1 meshes carry 1 pipeline
+        # combo, the pp=2 mesh carries len(schedules) x len(micro) = 4
+        assert len(cands) == 2 * 4 * 1 + 1 * 4 * 4
+        keys = [p.key() for p in cands]
+        assert len(keys) == len(set(keys)), "candidates must be unique"
+        # nothing silently dropped: every candidate is either alive or
+        # carries a prune reason
+        for p in cands:
+            assert p.prune_reason is None or p.feasible is False
+
+    def test_compat_truth_table(self):
+        def reason(**kw):
+            base = dict(
+                model="mlp", topology="1x4", dp=4, fsdp=1, pp=1,
+                policy="ddp", batch=16,
+            )
+            base.update(kw)
+            return planner._compat_prune(Plan(**base))
+
+        assert reason() is None
+        assert reason(dp=1, policy="zero2") == "compat:zero-needs-data-axis"
+        assert reason(dp=2, fsdp=2, policy="ddp") == "compat:ddp-uses-dp-axis"
+        assert (
+            reason(dp=2, pp=2, policy="zero3", pp_schedule="gpipe", pp_micro=2)
+            == "compat:pp-zero3"
+        )
+        assert reason(policy="zero3", wire="int8_block") == "compat:wire-zero3"
+        assert (
+            reason(dp=2, pp=2, wire="int8", pp_schedule="gpipe", pp_micro=2)
+            == "compat:wire-pp"
+        )
+        assert reason(batch=7, dp=4) == "compat:batch-divide"
+        assert (
+            reason(dp=2, pp=2, pp_schedule="gpipe", pp_micro=3)
+            == "compat:microbatch-divide"
+        )
+        assert (
+            reason(
+                dp=2, pp=2, pp_schedule="interleaved", pp_micro=2, pp_v=2,
+                batch=8,
+            )
+            is None
+        )
+
+    def test_analytic_bubble(self):
+        assert analytic_bubble("gpipe", 1, 4) == 0.0
+        assert analytic_bubble("gpipe", 4, 4) == pytest.approx(3 / 7)
+        assert analytic_bubble("1f1b", 2, 8) == pytest.approx(1 / 9)
+        # interleaving v=2 shrinks the bubble vs the same gpipe shape
+        assert analytic_bubble("interleaved", 4, 4, v=2) < analytic_bubble(
+            "gpipe", 4, 4
+        )
+
+
+# -- pruning truth table (fake probes — no compiles) ---------------------
+
+
+class _FakeReport:
+    def __init__(self, errors=()):
+        self.errors = list(errors)
+
+
+class _FakeFinding:
+    def __init__(self, rule):
+        self.rule = rule
+
+
+class TestPruning:
+    def _search(self, probe, **kw):
+        kw.setdefault("wires", (None,))
+        kw.setdefault("remats", ("none",))
+        kw.setdefault("policies", ("ddp", "zero2"))
+        return search("mlp", "1x2", probe=probe, **kw)
+
+    def test_memory_prune(self):
+        doc = self._search(
+            lambda p: (10_000, _FakeReport(), None),
+            budget_bytes=1000, safety=1.0,
+        )
+        assert doc["ranked"] == []
+        mem = [r for r in doc["pruned"] if str(r["prune_reason"]).startswith("memory:")]
+        assert mem and all(r["feasible"] is False for r in mem)
+
+    def test_static_prune(self):
+        doc = self._search(
+            lambda p: (100, _FakeReport([_FakeFinding("donation-conflict")]), None),
+        )
+        assert doc["ranked"] == []
+        assert any(
+            r["prune_reason"] == "static:donation-conflict"
+            for r in doc["pruned"]
+        )
+
+    def test_build_error_prune(self):
+        doc = self._search(lambda p: (None, None, "ValueError: boom"))
+        assert doc["ranked"] == []
+        assert any(
+            str(r["prune_reason"]).startswith("build:ValueError")
+            for r in doc["pruned"]
+        )
+
+    def test_survivors_passed_both_prunes(self):
+        doc = self._search(
+            lambda p: (500, _FakeReport(), None),
+            budget_bytes=1000, safety=1.0, top_k=2,
+        )
+        assert len(doc["ranked"]) == 2
+        for r in doc["ranked"]:
+            assert r["feasible"] is True
+            assert r["peak_bytes"] == 500
+            assert r["prune_reason"] is None
+
+    def test_probe_budget_is_loud(self):
+        doc = self._search(
+            lambda p: (10_000, _FakeReport(), None),
+            budget_bytes=1000, probe_limit=2, top_k=3,
+        )
+        assert doc["meta"]["probes_used"] == 2
+        assert any(
+            str(r["prune_reason"]).startswith("probe-budget:")
+            for r in doc["pruned"]
+        )
+
+    def test_no_hbm_budget_is_a_prune_reason(self):
+        from pytorch_distributedtraining_tpu.observe.memory import (
+            NoMemoryBudget,
+        )
+
+        def tuner(p):
+            raise NoMemoryBudget("no device memory budget: test")
+
+        doc = self._search(
+            lambda p: (100, _FakeReport(), None), tuner=tuner, top_k=1,
+        )
+        assert doc["ranked"] == []
+        assert any(
+            str(r["prune_reason"]).startswith("no-hbm-budget:")
+            for r in doc["pruned"]
+        )
+
+
+# -- calibration correction flips the winner -----------------------------
+
+
+class TestCalibration:
+    KW = dict(
+        policies=("ddp",), remats=("none",), wires=(None,),
+        schedules=("gpipe",), micro_factors=(2,), top_k=1, probe=False,
+    )
+
+    def test_seeded_bubble_ratio_flips_winner(self):
+        plain = search("mlp", "1x2", **self.KW)
+        top_plain = Plan.from_dict(plain["ranked"][0])
+        assert top_plain.pp == 2, "uncalibrated model prefers the pipe"
+
+        corrected = search(
+            "mlp", "1x2",
+            calibration={"bubble": {"ratio": 4.0}}, **self.KW,
+        )
+        top_cal = Plan.from_dict(corrected["ranked"][0])
+        assert top_cal.pp == 1 and top_cal.dp == 2, (
+            "a measured 4x bubble must flip the winner to pure dp"
+        )
+        assert top_cal.calibration["bubble"] == 4.0
+
+    def test_ratio_scales_its_own_term_only(self):
+        from pytorch_distributedtraining_tpu.analyze.planner import predict
+
+        lean = Plan(model="mlp", dp=2, remat="none", batch=16)
+        heavy = Plan(model="mlp", dp=2, remat="full", batch=16)
+        predict(lean)
+        predict(heavy)
+        base = (lean.predicted.copy(), heavy.predicted.copy())
+
+        predict(lean, calibration={"mfu_flops": {"ratio": 3.0}})
+        predict(heavy, calibration={"mfu_flops": {"ratio": 3.0}})
+        for plan, before in zip((lean, heavy), base):
+            assert plan.predicted["compute_s"] == pytest.approx(
+                3.0 * before["compute_s"]
+            )
+            assert plan.predicted["comm_s"] == before["comm_s"]
+        # candidates that differ only in a compute factor keep their
+        # order under a uniform compute ratio
+        assert lean.predicted["total_s"] < heavy.predicted["total_s"]
+
+
+# -- plan.json round-trip -------------------------------------------------
+
+
+class TestPlanRoundTrip:
+    def test_dict_round_trip(self):
+        p = Plan(
+            model="gpt2", topology="2x4", dp=4, fsdp=2, policy="zero2",
+            remat="full", wire="int8_block", predicted={"total_s": 1.0},
+            peak_bytes=123, feasible=True,
+        )
+        assert Plan.from_dict(p.to_dict()) == p
+        # unknown keys from a future schema are ignored, not fatal
+        d = p.to_dict()
+        d["from_the_future"] = 1
+        assert Plan.from_dict(d) == p
+
+    def test_write_load_doc(self, tmp_path):
+        doc = plan_doc(
+            [Plan(dp=2), Plan(dp=1, fsdp=2, policy="zero2")],
+            meta={"topology": "1x2"},
+        )
+        path = write_plan(str(tmp_path / "plan.json"), doc)
+        top = load_plan(path)
+        assert (top.rank, top.dp) == (1, 2)
+        # bare plan dict and inline JSON spellings
+        assert load_plan(json.dumps(doc)).dp == 2
+        assert load_plan(json.dumps(Plan(dp=4).to_dict())).dp == 4
+
+    def test_load_rejects_empty_and_garbage(self, tmp_path):
+        with pytest.raises(ValueError, match="empty ranking"):
+            load_plan(json.dumps({"version": 1, "ranked": []}))
+        bad = tmp_path / "bad.json"
+        bad.write_text("not json")
+        with pytest.raises(ValueError):
+            load_plan(str(bad))
+        with pytest.raises(OSError):
+            load_plan(str(tmp_path / "missing.json"))
+
+
+# -- GRAFT_PLAN apply precedence -----------------------------------------
+
+
+class TestApplyPrecedence:
+    def _cfg(self, **kw):
+        from pytorch_distributedtraining_tpu.stoke.config import TPUConfig
+
+        return TPUConfig(**kw)
+
+    def test_default_config_adopts_plan(self):
+        p = Plan(
+            dp=2, fsdp=2, pp=2, policy="zero2", remat="full",
+            wire="int8_block", pp_schedule="gpipe", pp_micro=4,
+        )
+        cfg, conflicts = apply_plan_to_config(p, self._cfg(), env={})
+        assert conflicts == []
+        assert (cfg.dp, cfg.fsdp, cfg.pp) == (2, 2, 2)
+        assert cfg.remat == "full"
+        assert cfg.wire == "int8_block"
+        assert (cfg.pp_schedule, cfg.pp_micro) == ("gpipe", 4)
+
+    def test_explicit_field_wins_with_conflict(self):
+        p = Plan(dp=2, fsdp=1, wire="int8_block")
+        cfg, conflicts = apply_plan_to_config(
+            p, self._cfg(wire="fp8_e4m3"), env={}
+        )
+        assert cfg.wire == "fp8_e4m3"
+        assert cfg.dp == 2  # non-conflicting knobs still adopt the plan
+        assert [c["knob"] for c in conflicts] == ["wire"]
+        assert conflicts[0]["plan"] == "int8_block"
+
+    def test_env_twin_wins_with_conflict(self):
+        p = Plan(dp=2, remat="full")
+        cfg, conflicts = apply_plan_to_config(
+            p, self._cfg(), env={"GRAFT_REMAT": "dots"}
+        )
+        assert cfg.remat is False  # env twin owns the knob downstream
+        assert [c["knob"] for c in conflicts] == ["remat"]
+        assert conflicts[0]["explicit"] == "dots"
+
+    def test_agreeing_explicit_is_not_a_conflict(self):
+        p = Plan(dp=2, remat="full")
+        cfg, conflicts = apply_plan_to_config(
+            p, self._cfg(remat="full"), env={}
+        )
+        assert conflicts == []
+        assert cfg.remat == "full"
+
+    def test_policy_flags(self):
+        assert Plan(policy="ddp").policy_flags() == {}
+        assert Plan(policy="zero2").policy_flags() == {
+            "fairscale_oss": True, "fairscale_sddp": True,
+        }
+        with pytest.raises(ValueError):
+            Plan(policy="zero9").policy_flags()
+
+
+# -- CLI exit codes -------------------------------------------------------
+
+
+class TestCLI:
+    def test_usage_errors_exit_2(self, tmp_path, capsys):
+        assert planner.main(["--topology", "2x"]) == 2
+        assert planner.main(
+            ["--topology", "1x2", "--policies", "zero9"]
+        ) == 2
+        assert planner.main(
+            ["--topology", "1x2", "--calibration",
+             str(tmp_path / "nope.json"), "--no-probe"]
+        ) == 2
+        capsys.readouterr()
+
+    def test_no_survivors_exit_1(self, tmp_path, capsys):
+        # a 1-device topology cannot host any ZeRO policy: every
+        # candidate compat-prunes, the ranking is empty
+        out = tmp_path / "plan.json"
+        rc = planner.main(
+            ["--topology", "1", "--policies", "zero2", "--no-probe",
+             "--out", str(out)]
+        )
+        assert rc == 1
+        assert json.loads(out.read_text())["ranked"] == []
+        capsys.readouterr()
+
+    def test_rank_only_exit_0_and_doc(self, tmp_path, capsys):
+        out = tmp_path / "plan.json"
+        rc = planner.main(
+            ["--topology", "1x2", "--model", "mlp", "--no-probe",
+             "--wires", "off", "--remats", "none", "--out", str(out)]
+        )
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert doc["meta"]["probed"] is False
+        assert [r["rank"] for r in doc["ranked"]] == list(
+            range(1, len(doc["ranked"]) + 1)
+        )
+        capsys.readouterr()
+
+
+# -- runtime rules: plan-stale / plan-infeasible -------------------------
+
+
+def _run_runtime_rules():
+    from pytorch_distributedtraining_tpu.analyze import (
+        AnalysisContext,
+        run_rules,
+    )
+
+    return run_rules(
+        AnalysisContext(), planes=("runtime",), ignore=frozenset()
+    )
+
+
+class TestPlanRules:
+    def test_quiet_without_active_plan(self):
+        report = _run_runtime_rules()
+        assert report.by_rule("plan-stale") == []
+        assert report.by_rule("plan-infeasible") == []
+
+    def test_plan_stale_warns(self):
+        record_applied(Plan(dp=8, feasible=True), now=100.0)
+        assert plan_mod.mark_stale("calibration drift past tolerance 0.5")
+        report = _run_runtime_rules()
+        findings = report.by_rule("plan-stale")
+        assert len(findings) == 1
+        from pytorch_distributedtraining_tpu.analyze import Severity
+
+        assert findings[0].severity == Severity.WARN
+        assert "drift" in findings[0].message
+
+    def test_plan_infeasible_errors(self):
+        import jax
+
+        p = Plan(dp=2, topology="1x2", feasible=True, peak_bytes=10**15)
+        reason = record_applied(
+            p, device_count=jax.device_count(), budget_bytes=10**9,
+        )
+        assert reason is not None
+        report = _run_runtime_rules()
+        findings = report.by_rule("plan-infeasible")
+        assert len(findings) == 1
+        from pytorch_distributedtraining_tpu.analyze import Severity
+
+        assert findings[0].severity == Severity.ERROR
+
+    def test_device_count_mismatch_is_infeasible(self):
+        reason = record_applied(
+            Plan(dp=4, topology="1x4", feasible=True), device_count=8,
+        )
+        assert "8" in reason
+        assert plan_mod.runtime_stats["infeasible"] == reason
+
+    def test_rank_time_pruned_plan_is_infeasible(self):
+        reason = record_applied(
+            Plan(dp=8, feasible=False, prune_reason="memory:..."),
+            device_count=8,
+        )
+        assert "pruned at rank time" in reason
+
+    def test_mark_stale_without_plan_is_noop(self):
+        assert plan_mod.mark_stale("whatever") is False
+        assert plan_mod.runtime_stats["stale"] is False
+
+
+# -- drift -> stale -> re-rank control loop (fake clock) -----------------
+
+
+class TestDriftControlLoop:
+    def test_calibrate_drift_marks_plan_stale_and_rerank_flips(self, monkeypatch):
+        from pytorch_distributedtraining_tpu.observe import opcost
+
+        monkeypatch.setenv("GRAFT_CALIB_DRIFT_TOL", "0.5")
+        kw = TestCalibration.KW
+
+        # t0: plan with the stock model, apply the winner (the pipe)
+        first = search("mlp", "1x2", **kw)
+        applied = Plan.from_dict(first["ranked"][0])
+        assert applied.pp == 2
+        record_applied(applied, now=1000.0)
+        assert plan_mod.runtime_stats["applied_at"] == 1000.0
+        assert plan_mod.runtime_stats["stale"] is False
+
+        # t1: measurement says bubbles cost 4x the analytic model;
+        # drift vs the previous ratio (1.0) is +3.0 > tol
+        cal = opcost.calibrate(
+            {"bubble": {"analytic": 0.2, "measured": 0.8, "unit": "frac"}},
+            previous={"bubble": {"ratio": 1.0}},
+        )
+        assert cal["bubble"]["drift"] == pytest.approx(3.0)
+        assert plan_mod.runtime_stats["stale"] is True
+        assert "drift" in plan_mod.runtime_stats["stale_reason"]
+
+        # t2: the next planner invocation re-ranks with the fresh
+        # calibration — and the winner flips off the pipe
+        second = search(
+            "mlp", "1x2",
+            calibration={"bubble": cal["bubble"]}, **kw,
+        )
+        assert second["meta"]["reranked_from_stale"] is True
+        new_top = Plan.from_dict(second["ranked"][0])
+        assert new_top.key() != applied.key()
+        assert new_top.dp == 2 and new_top.pp == 1
+
+    def test_drift_within_tol_stays_fresh(self, monkeypatch):
+        from pytorch_distributedtraining_tpu.observe import opcost
+
+        monkeypatch.setenv("GRAFT_CALIB_DRIFT_TOL", "0.5")
+        record_applied(Plan(dp=8, feasible=True), now=1.0)
+        opcost.calibrate(
+            {"wire": {"analytic": 100.0, "measured": 120.0, "unit": "B"}},
+            previous={"wire": {"ratio": 1.0}},
+        )
+        assert plan_mod.runtime_stats["stale"] is False
+
+
+# -- tune_batch_size: cache + strict refusal -----------------------------
+
+
+class TestTuneBatchReuse:
+    def test_cache_avoids_relowering(self):
+        from pytorch_distributedtraining_tpu.observe.memory import (
+            tune_batch_size,
+        )
+
+        calls = []
+
+        def peak_fn(b):
+            calls.append(b)
+            return b * 100
+
+        cache = {}
+        got = tune_batch_size(
+            peak_fn, budget_bytes=1000, start=1, max_batch=64,
+            safety=1.0, cache=cache,
+        )
+        assert got == 10
+        assert len(calls) == len(set(calls)), "no probe is paid twice"
+        # a second tune over the same closure re-lowers nothing
+        calls.clear()
+        assert tune_batch_size(
+            peak_fn, budget_bytes=1000, start=1, max_batch=64,
+            safety=1.0, cache=cache,
+        ) == 10
+        assert calls == []
+
+    def test_no_budget_raises_typed(self, monkeypatch):
+        from pytorch_distributedtraining_tpu.observe import memory
+
+        monkeypatch.setattr(
+            memory, "device_hbm_budget", lambda *a, **k: None
+        )
+        with pytest.raises(memory.NoMemoryBudget):
+            memory.tune_batch_size(lambda b: 1, start=1)
+
+
+# -- unified cost surface -------------------------------------------------
+
+
+class TestCostSurface:
+    UNIFIED = {"collective", "fp32_bytes", "wire_bytes", "wire_format",
+               "axis", "axis_size"}
+
+    def _cost(self, plan):
+        from pytorch_distributedtraining_tpu.analyze.planner import (
+            build_step,
+        )
+        from pytorch_distributedtraining_tpu.parallel import CostSurface
+
+        step, state, _batch = build_step(plan)
+        assert isinstance(step, CostSurface)
+        return step.comm_cost(state.params)
+
+    def test_train_step(self):
+        cost = self._cost(Plan(model="mlp", topology="1x2", dp=2, batch=16))
+        assert self.UNIFIED <= set(cost)
+        assert cost["wire_format"] is None
+        assert cost["wire_bytes"] == cost["fp32_bytes"] > 0
+
+    def test_compressed_step(self):
+        # gpt2's embedding leaves clear the wire's min_wire_elems floor
+        # (TinyMLP's do not — they would ride the f32 wire untouched)
+        cost = self._cost(
+            Plan(
+                model="gpt2", topology="1x2", dp=2, policy="zero1",
+                wire="int8_block", batch=16,
+            )
+        )
+        assert self.UNIFIED <= set(cost)
+        assert cost["wire_format"].startswith("int8_block")
+        assert 0 < cost["wire_bytes"] < cost["fp32_bytes"]
+
+    def test_pipeline_step(self):
+        cost = self._cost(
+            Plan(
+                model="mlp", topology="1x4", dp=2, pp=2, policy="zero1",
+                pp_schedule="gpipe", pp_micro=2, batch=16,
+            )
+        )
+        assert self.UNIFIED <= set(cost)
+        assert cost["axis"] == "dp" and cost["axis_size"] == 2
+        assert cost["wire_bytes"] == cost["fp32_bytes"] > 0
