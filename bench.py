@@ -2452,6 +2452,15 @@ def _bench() -> None:
                         meta={
                             "metric": METRIC,
                             "value": round(img_per_sec, 2),
+                            # measured per-axis collective bandwidth —
+                            # parallel/hierarchy.py (bucket sizing) and
+                            # the planner's --axis-bw auto-load read
+                            # this back instead of analytic constants
+                            "axis_bandwidth": {
+                                ax: round(row["bytes_per_s"], 1)
+                                for ax, row in bw.items()
+                                if row.get("bytes_per_s")
+                            } or None,
                         },
                     )
                     print(

@@ -62,6 +62,9 @@ STAGES = [
              "CPU mesh — plan_rank_of_measured_best, "
              "plan_predicted_vs_measured_ratio, GRAFT_PLAN apply "
              "round-trip (bench.py, GRAFT_BENCH_PLAN=1)"),
+    ("hier", "flat vs two-level grad sync on a hybrid mesh — dcn_bytes "
+             "vs dcn_bytes_flat_twin at equal loss, plus the slow-DCN "
+             "degrade drill's time_to_degrade_s (hier_bench.py)"),
     ("fleet", "fleet observability: merged cross-host trace rollup "
               "(trace_summary.py per-host lanes) + perf-regression "
               "sentry vs the BENCH_* trajectory (regress.py)"),
@@ -142,6 +145,9 @@ ARM_KNOBS = {
     "serve_fleet": "GRAFT_BENCH_SERVE_FLEET=1",
     # planner A/B arm (calibration record, never a throughput winner)
     "plan": "GRAFT_BENCH_PLAN=1",
+    # hierarchical grad-sync arm (bytes record; headline dcn_bytes, lower
+    # is better — never a throughput winner)
+    "hier": "GRAFT_HIER=1",
     # numerics plane arm (health record, never a throughput winner)
     "numerics": "GRAFT_NUMERICS=1 GRAFT_NUMERICS_ACTION=halt",
     # op-cost attribution arm (attribution record, never a winner)

@@ -46,7 +46,9 @@ from pytorch_distributedtraining_tpu.parallel import (
     create_train_state,
     wire_format,
 )
-from pytorch_distributedtraining_tpu.runtime.mesh import MeshSpec, batch_spec, make_mesh
+from pytorch_distributedtraining_tpu.runtime.mesh import (
+    MeshSpec, batch_spec, make_hybrid_mesh, make_mesh,
+)
 
 # reference constants (Fairscale-DDP.py:57,116,118)
 BATCH_SIZE = 40
@@ -67,6 +69,13 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
 
     telemetry.configure_from_env()
     pp = max(1, int(getattr(opt, "pp", 1)))
+    # --hier/$GRAFT_HIER: two-level gradient sync. The mesh gains a slice
+    # (dp/DCN) axis of 2; the within-slice axis keeps the ZeRO2 shards.
+    hier = getattr(opt, "hier", None)
+    if hier is None:
+        hier = os.environ.get("GRAFT_HIER", "").strip().lower() not in (
+            "", "0", "false", "off", "no"
+        )
     if pp > 1:
         # --pp shapes the mesh with a pipeline axis (remaining devices on
         # the sharded-DP axis). ESPCN has no uniform stacked stage trunk,
@@ -79,8 +88,28 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
               f"fsdp={fsdp} x pp={pp}; ESPCN has no stacked stages, pp "
               "ranks replicate (see parallel.PipelineStep)")
         mesh = make_mesh(MeshSpec(fsdp=fsdp, pp=pp))
+        if hier:
+            print("--hier ignored under --pp (the pipelined mesh has no "
+                  "slice axis; hierarchy needs the data devices)")
+            hier = False
     else:
-        mesh = make_mesh(MeshSpec.zero())
+        import jax as _jax
+
+        n_dev = _jax.device_count()
+        if hier and n_dev >= 4 and n_dev % 2 == 0:
+            # two slices of n/2: dp rides DCN, fsdp keeps the ZeRO2
+            # shards on the within-slice (ICI) links
+            mesh = make_hybrid_mesh(
+                MeshSpec(fsdp=n_dev // 2), dcn_dp=2
+            )
+            print(f"===> Hierarchical sync: 2 slices x fsdp={n_dev // 2} "
+                  "(reduce-scatter on ICI, cross-slice all-reduce on DCN)")
+        else:
+            if hier:
+                print(f"--hier needs >= 4 devices in an even split, have "
+                      f"{n_dev}; flat sync")
+                hier = False
+            mesh = make_mesh(MeshSpec.zero())
 
     print("===> Loading datasets")
     input_path = getattr(opt, "input_dir", INPUT_PATH)
@@ -197,10 +226,12 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
         ).arm()
     if wire is not None and pp == 1:
         # MeshSpec.zero() puts every device on the sharded-DP axis, so
-        # the quantized hop IS the fsdp axis here
+        # the quantized hop is the fsdp axis there; on the --hier hybrid
+        # mesh the quantized hop is the dp (DCN) crossing — the only
+        # link narrow enough to care
         step = CompressedGradStep(
             loss_fn, tx, mesh, ZeRO2(remat=remat),
-            axis_name="fsdp", wire=wire, numerics=probe,
+            axis_name="dp" if hier else "fsdp", wire=wire, numerics=probe,
         )
         cost = step.wire_cost(state.params)
         print(f"===> Quantized wire {cost['wire_format']}: "
@@ -208,6 +239,16 @@ def train(rank: int, world_size: int, epochs: int, opt=None):
               f"{cost['fp32_bytes']} fp32 "
               f"({cost['wire_fraction_quantized']:.1%} of gradient "
               "elements quantized)")
+    elif hier:
+        from pytorch_distributedtraining_tpu.parallel import HierGradStep
+
+        step = HierGradStep(
+            loss_fn, tx, mesh, ZeRO2(remat=remat), numerics=probe,
+        )
+        cost = step.dcn_cost(state.params)
+        print(f"===> Two-level sync: {cost['dcn_bytes']} bytes/step on "
+              f"the DCN hop vs {cost['dcn_bytes_flat_twin']} flat "
+              f"(1/{cost['ici_size']} of the gradient crosses slices)")
     else:
         if wire is not None:
             print("--wire ignored under --pp (the pipelined mesh's "
@@ -355,10 +396,17 @@ def main(argv=None):
                              "int8_block/fp8_e4m3/fp8_e5m2, optional "
                              ":BLOCK suffix (env twin $GRAFT_WIRE; "
                              "default: f32 collectives)")
+    parser.add_argument("--hier", action="store_true", default=None,
+                        help="two-level gradient sync: split the data "
+                             "devices into 2 slices (dp rides DCN via "
+                             "make_hybrid_mesh) and reduce-scatter within "
+                             "the slice before the cross-slice hop (env "
+                             "twin $GRAFT_HIER; composes with --wire — "
+                             "the quantized hop becomes the DCN axis)")
     parser.add_argument("--plan", type=str,
                         default=os.environ.get("GRAFT_PLAN"),
                         help="auto-planner plan.json (path or inline JSON): "
-                             "threads the top-ranked plan's remat/wire "
+                             "threads the top-ranked plan's remat/wire/hier "
                              "through their env twins when not set "
                              "explicitly; this driver's engine is fixed "
                              "ZeRO2, so a plan asking for another "
@@ -449,7 +497,21 @@ def main(argv=None):
         elif (opt.wire or os.environ.get("GRAFT_WIRE")) != want["wire"]:
             print(f"===> plan conflict: explicit wire wins over the "
                   f"plan's {want['wire']!r}")
-        if plan.policy != "zero2" or plan.pp > 1 or plan.dp > 1:
+        if opt.hier is None and not os.environ.get("GRAFT_HIER"):
+            if want.get("hier"):
+                os.environ["GRAFT_HIER"] = "1"
+        elif bool(
+            opt.hier
+            or os.environ.get("GRAFT_HIER", "").strip().lower()
+            not in ("", "0", "false", "off", "no")
+        ) != bool(want.get("hier")):
+            print(f"===> plan conflict: explicit hier wins over the "
+                  f"plan's {bool(want.get('hier'))!r}")
+        if plan.policy != "zero2" or plan.pp > 1 or (
+            # dp=2 + hier IS this driver's hybrid mesh (2 slices); any
+            # other dp asks for a mesh the fixed engine won't build
+            plan.dp > 1 and not (want.get("hier") and plan.dp == 2)
+        ):
             print(f"===> plan conflict: this driver's fixed ZeRO2 mesh "
                   f"overrides the plan's {plan.describe()!r}")
 

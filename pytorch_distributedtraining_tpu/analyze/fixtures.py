@@ -224,6 +224,70 @@ def wire_backoff_fixture(devices=None):
     return step, state, batch, ("wire-backoff", Severity.ERROR)
 
 
+def dcn_flat_ring_fixture(devices=None):
+    """Flat joint-axis psum of a full gradient on a hybrid mesh: the
+    replica groups span both slices while the payload is the whole
+    un-scattered leaf — exactly the flat-ring-over-DCN hazard the
+    hierarchical form (reduce-scatter on ICI first) exists to avoid.
+    Needs 4 devices (2 slices x 2-wide ICI); the test harness provides 8
+    virtual CPU devices."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.collectives import shard_map
+    from ..parallel.state import TrainState
+    from ..runtime.mesh import make_hybrid_mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < 4:
+        raise ValueError(
+            "dcn-flat-ring fixture needs >= 4 devices (2 slices x 2 ICI)"
+        )
+    mesh = make_hybrid_mesh(MeshSpec(fsdp=2), dcn_dp=2, devices=devs[:4])
+
+    def fn(state, batch, lr_factor):
+        x, y = batch
+
+        def local(w, x, y):
+            def loss_f(w):
+                return jnp.mean((x @ w - y) ** 2)
+
+            loss, g = jax.value_and_grad(loss_f)(w)
+            # flat ring over BOTH axes: the full leaf crosses the slice
+            # boundary un-scattered — the violation
+            g = lax.psum(lax.psum(g, "fsdp"), "dp")
+            loss = lax.pmean(lax.pmean(loss, "fsdp"), "dp")
+            new_params = {"w": w - lr_factor * 1e-3 * g}
+            return new_params, loss
+
+        params, loss = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(("dp", "fsdp")), P(("dp", "fsdp"))),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )(state.params["w"], x, y)
+        return state.replace(step=state.step + 1, params=params), loss
+
+    rng = np.random.default_rng(0)
+    # the leaf must clear DCN_FLAT_MIN_ELEMS or the rule would excuse
+    # the crossing as latency-bound
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params={"w": jnp.zeros((1024, 16), jnp.float32)},
+        opt_state=(),
+        model_state={},
+        rng=jax.random.PRNGKey(0),
+    )
+    batch = (
+        jnp.asarray(rng.normal(size=(16, 1024)).astype(np.float32)),
+        jnp.asarray(rng.normal(size=(16, 16)).astype(np.float32)),
+    )
+    step = _FixtureStep(fn, mesh, donate=False)
+    step.hier = "dp"  # the hierarchy claim the flat ring betrays
+    return step, state, batch, ("dcn-flat-ring", Severity.ERROR)
+
+
 def untagged_remat(devices=None):
     """remat='names' over a model with no checkpoint_name tags: the
     policy saves nothing and silently degrades to full remat."""
@@ -239,6 +303,7 @@ FIXTURES = {
     "giant-constant": giant_constant,
     "untagged-remat": untagged_remat,
     "wire-backoff": wire_backoff_fixture,
+    "dcn-flat-ring": dcn_flat_ring_fixture,
 }
 
 

@@ -26,6 +26,11 @@ from .registry import rule
 # math on a few KiB is not a hazard worth failing a run over
 BACKOFF_MIN_LEAF_ELEMS = 16384
 
+# dcn-flat-ring only flags slice-crossing collectives at least this
+# large: a toy step's full gradient crossing DCN costs microseconds and
+# the hierarchy's own latency would exceed the bandwidth saved
+DCN_FLAT_MIN_ELEMS = 4096
+
 
 def _alias_entries(hlo_text: str) -> int:
     """Count input_output_alias entries in the HloModule header."""
@@ -273,6 +278,74 @@ def wire_backoff(ctx):
             "byte is crossing the wire at full width",
             evidence=f"collectives={[repr(c) for c in inv[:6]]}",
         )
+
+
+@rule(
+    "dcn-flat-ring",
+    "hlo",
+    "collective crosses the slice boundary at un-scattered gradient size",
+)
+def dcn_flat_ring(ctx):
+    """On a hybrid mesh (``make_hybrid_mesh``, >1 slice) the gradient
+    sync must be the two-level form: reduce-scatter within-slice, then
+    cross-slice collectives on the 1/ICI-size shard. A collective whose
+    replica groups CROSS the slice boundary while carrying full
+    (un-scattered) gradient-sized payloads is a flat ring over DCN —
+    every device ships every gradient byte over the slowest link. The
+    audit machinery is ``observe.hlo.hierarchy_audit``; single-slice
+    meshes (no registered slice axis) have no boundary and stay quiet.
+
+    Like ``wire-backoff``, this audits a CLAIM: it runs only when the
+    step declares hierarchical sync (``ctx.hier``, auto-threaded from
+    ``step.dcn_axis`` — HierGradStep and hybrid CompressedGradStep
+    carry it) and fails when the compiled module betrays it. jax
+    interns ``Mesh`` objects (equal layouts are the same object), so a
+    registered slice axis alone cannot prove THIS step meant to be
+    hierarchical — the claim gate keeps unrelated steps on an equal
+    mesh out of scope.
+    """
+    if not ctx.hlo_text or ctx.mesh is None or ctx.params is None:
+        return
+    if not getattr(ctx, "hier", None):
+        return
+    from ..observe.hlo import hierarchy_audit
+    from ..runtime.mesh import slice_axis
+
+    dcn = slice_axis(ctx.mesh)
+    if dcn is None:
+        return
+    import jax
+
+    grad_elems = sum(
+        int(getattr(p, "size", 0))
+        for p in jax.tree_util.tree_leaves(ctx.params)
+    )
+    if grad_elems < DCN_FLAT_MIN_ELEMS:
+        return
+    audit = hierarchy_audit(
+        ctx.hlo_text, ctx.mesh, grad_elems=grad_elems, dcn_axis=dcn
+    )
+    offenders = [
+        f for f in audit.flat_rings if f.elems >= DCN_FLAT_MIN_ELEMS
+    ]
+    if not offenders:
+        return
+    worst = max(offenders, key=lambda f: f.elems)
+    yield Finding(
+        "dcn-flat-ring",
+        Severity.ERROR,
+        f"hlo:{worst.kind}",
+        f"{len(offenders)} collective"
+        f"{'s' if len(offenders) != 1 else ''} cross"
+        f"{'' if len(offenders) != 1 else 'es'} the slice boundary "
+        f"({dcn!r}) carrying un-scattered gradient-sized payloads "
+        f"(worst: {worst.kind} {worst.dtype} x {worst.elems} elems; "
+        f"two-level bound {audit.shard_elems_bound} at ici_size "
+        f"{audit.ici_size}): the grad sync is a flat ring over DCN — "
+        "use the hierarchical form (GRAFT_HIER / HierGradStep, or "
+        "CompressedGradStep on a hybrid mesh)",
+        evidence="; ".join(repr(f) for f in offenders[:4]),
+    )
 
 
 @rule(

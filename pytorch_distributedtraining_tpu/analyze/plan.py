@@ -40,6 +40,7 @@ ENV_TWINS = {
     "pp": "GRAFT_PP",
     "pp_schedule": "GRAFT_PP_SCHEDULE",
     "pp_micro": "GRAFT_PP_MICRO",
+    "hier": "GRAFT_HIER",
 }
 
 # plan.policy -> the facade's ctor engine flags (policy_from_flags)
@@ -90,6 +91,7 @@ class Plan:
     pp_micro: int = 0
     pp_v: int = 1           # virtual stages per rank (interleaved >= 2)
     wire: str | None = None
+    hier: bool = False      # two-level grad sync (dp axis rides DCN)
     batch: int = 16         # global batch the costs were modeled at
     # filled by the planner:
     predicted: dict = field(default_factory=dict)
@@ -109,6 +111,7 @@ class Plan:
         return (
             self.dp, self.fsdp, self.pp, self.policy, self.remat,
             self.pp_schedule, self.pp_micro, self.pp_v, self.wire,
+            self.hier,
         )
 
     def describe(self) -> str:
@@ -122,6 +125,8 @@ class Plan:
             bits.append(f"{self.pp_schedule}/m{self.pp_micro}")
         if self.wire:
             bits.append(f"wire={self.wire}")
+        if self.hier:
+            bits.append("hier")
         return " ".join(bits)
 
     def to_dict(self) -> dict:
@@ -142,6 +147,7 @@ class Plan:
             "pp": self.pp,
             "remat": False if self.remat in ("none", "", None) else self.remat,
             "wire": self.wire or None,
+            "hier": bool(self.hier),
         }
         if self.pp > 1:
             out["pp_schedule"] = self.pp_schedule

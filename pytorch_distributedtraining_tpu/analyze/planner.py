@@ -46,6 +46,7 @@ DEFAULT_REMATS = ("none", "full")
 DEFAULT_WIRES = (None, "int8_block")
 DEFAULT_SCHEDULES = ("gpipe", "1f1b")
 DEFAULT_MICRO_FACTORS = (1, 2)  # pp_micro = factor * pp stages
+DEFAULT_HIERS = (False, True)   # flat vs two-level grad sync
 
 # fwd-recompute overhead of each remat policy on the compute term
 REMAT_COMPUTE = {
@@ -70,6 +71,7 @@ POLICY_GRAD_HOPS = {"ddp": 2, "zero1": 2, "zero2": 1, "zero3": 1}
 POLICY_GATHER_HOPS = {"ddp": 0, "zero1": 1, "zero2": 1, "zero3": 2}
 
 DEFAULT_AXIS_BW = 1.8e10  # bytes/s on the data-parallel hop (ICI-class)
+DEFAULT_DCN_BW = 2.5e9  # bytes/s across slices when dp rides DCN (hier)
 DEFAULT_PEAK_FLOPS = 100e9  # planning-host stand-in (goodput's cpu entry)
 
 # memory-budget safety margin, same default as observe.memory.tune_batch_size
@@ -129,6 +131,15 @@ def parse_topology(spec: str) -> int:
     )
 
 
+def topology_slices(spec) -> int:
+    """Slice count of a topology spec: 'AxB' is A slices of B chips
+    (the A dimension is the DCN hop), a bare device count is one slice.
+    The cost model uses this to charge any data ring wider than one
+    slice its DCN crossing — a flat fsdp=8 on 2x4 is NOT ICI-fast."""
+    m = _TOPOLOGY.match(str(spec).strip().lower())
+    return int(m.group(1)) if m else 1
+
+
 def factorizations(n: int):
     """All (dp, fsdp, pp) triples with dp*fsdp*pp == n, dp-major order
     (pure data-parallel first, deepest pipeline last)."""
@@ -154,10 +165,23 @@ def _compat_prune(p: Plan) -> str | None:
     w = p.dp * p.fsdp
     if p.policy != "ddp" and w <= 1:
         return "compat:zero-needs-data-axis"
-    if p.policy == "ddp" and p.fsdp > 1:
+    if p.policy == "ddp" and p.fsdp > 1 and not p.hier:
         # DDP's twin already lives on the dp axis; the fsdp spelling of
-        # the same layout would double-count the candidate
+        # the same layout would double-count the candidate. Under hier
+        # the two axes are DIFFERENT links (dp=DCN, fsdp=ICI), so the
+        # split is a distinct layout, not a respelling.
         return "compat:ddp-uses-dp-axis"
+    if p.hier:
+        if p.dp <= 1:
+            return "compat:hier-needs-slices"  # no DCN axis to tier over
+        if p.fsdp <= 1:
+            # no within-slice axis to reduce-scatter on first — the
+            # "two-level" form would degenerate to the flat ring
+            return "compat:hier-needs-ici-axis"
+        if p.pp > 1:
+            return "compat:hier-pp"  # HierGradStep has no pipeline path
+        if p.policy == "zero3":
+            return "compat:hier-zero3"  # sharded params need gathers
     if p.pp > 1 and p.policy == "zero3":
         return "compat:pp-zero3"  # PipelineStep rejects sharded params
     if p.wire and p.policy == "zero3":
@@ -185,6 +209,7 @@ def enumerate_candidates(
     wires=DEFAULT_WIRES,
     schedules=DEFAULT_SCHEDULES,
     micro_factors=DEFAULT_MICRO_FACTORS,
+    hiers=DEFAULT_HIERS,
 ) -> list:
     """The full candidate list for a topology, compat prunes stamped.
 
@@ -210,18 +235,19 @@ def enumerate_candidates(
             for remat in remats:
                 for wire in wires:
                     for sched, micro, v in pipeline_combos:
-                        p = Plan(
-                            model=model, topology=str(topology),
-                            dp=dp, fsdp=fsdp, pp=pp, policy=policy,
-                            remat=remat, pp_schedule=sched,
-                            pp_micro=micro, pp_v=v, wire=wire,
-                            batch=batch,
-                        )
-                        reason = _compat_prune(p)
-                        if reason:
-                            p.prune_reason = reason
-                            p.feasible = False
-                        out.append(p)
+                        for hier in hiers:
+                            p = Plan(
+                                model=model, topology=str(topology),
+                                dp=dp, fsdp=fsdp, pp=pp, policy=policy,
+                                remat=remat, pp_schedule=sched,
+                                pp_micro=micro, pp_v=v, wire=wire,
+                                hier=hier, batch=batch,
+                            )
+                            reason = _compat_prune(p)
+                            if reason:
+                                p.prune_reason = reason
+                                p.feasible = False
+                            out.append(p)
     return out
 
 
@@ -259,6 +285,26 @@ def _peak_flops() -> float:
     return DEFAULT_PEAK_FLOPS
 
 
+def _bw_for(axis_bw, axis: str, *, dcn: bool = False) -> float:
+    """Resolve one axis' bytes/s from a scalar or a per-axis dict.
+
+    A scalar (the legacy --axis-bw form) applies to every hop. A dict —
+    calibration.json's ``meta.axis_bandwidth``, the measured form —
+    looks up the axis; a missing axis falls back to the analytic
+    constant for its link class (DCN for the dp hop of a hier plan,
+    ICI otherwise), so hier ranking never silently treats an
+    unmeasured DCN hop as ICI-fast.
+    """
+    if axis_bw is None:
+        return DEFAULT_DCN_BW if dcn else DEFAULT_AXIS_BW
+    if isinstance(axis_bw, dict):
+        v = axis_bw.get(axis)
+        if v:
+            return float(v)
+        return DEFAULT_DCN_BW if dcn else DEFAULT_AXIS_BW
+    return float(axis_bw)
+
+
 def _cal_ratio(calibration: dict | None, name: str) -> float:
     row = (calibration or {}).get(name) or {}
     ratio = row.get("ratio")
@@ -271,14 +317,22 @@ def predict(
     plan: Plan,
     *,
     calibration: dict | None = None,
-    axis_bw: float = DEFAULT_AXIS_BW,
+    axis_bw=DEFAULT_AXIS_BW,
     peak: float = DEFAULT_PEAK_FLOPS,
 ) -> float:
     """Fill ``plan.predicted`` with the calibrated step-time model and
     return total_s. Terms: compute (FLOPs / peak, x remat recompute,
     x the ``mfu_flops`` ratio), comm (policy hop bytes / axis
     bandwidth, grad hop x the ``wire`` ratio), bubble (analytic
-    schedule bubble x the ``bubble`` ratio, divides the busy time)."""
+    schedule bubble x the ``bubble`` ratio, divides the busy time).
+
+    ``axis_bw`` is a scalar (one bytes/s for every hop) or a per-axis
+    dict (calibration.json's measured ``meta.axis_bandwidth``). Hier
+    plans split the comm term by link: the 1/fsdp-scattered grad hop
+    at the dp (DCN) bandwidth, the within-slice reduce-scatter /
+    all-gather at the fsdp (ICI) bandwidth — so a measured slow DCN
+    ranks the two-level form above the flat ring it replaces.
+    """
     cal = {
         "mfu_flops": _cal_ratio(calibration, "mfu_flops"),
         "wire": _cal_ratio(calibration, "wire"),
@@ -292,14 +346,50 @@ def predict(
     w = plan.dp * plan.fsdp
     stage_param_bytes = MODELS[plan.model]["param_count"] * 4.0 / plan.pp
     comm_bytes = 0.0
-    if w > 1:
+    dcn_bytes = 0.0
+    comm_s = 0.0
+    wire_f = (
+        WIRE_FACTOR.get(plan.wire.partition(":")[0], 1.0) if plan.wire else 1.0
+    )
+    if plan.hier:
+        # two-level: reduce-scatter over fsdp (ICI) first, so only a
+        # 1/fsdp shard of the gradient crosses the slice boundary; the
+        # wire format (when any) narrows ONLY that DCN hop
+        frac_dp = (plan.dp - 1) / plan.dp
+        frac_fsdp = (plan.fsdp - 1) / plan.fsdp
+        dcn_bytes = (
+            POLICY_GRAD_HOPS[plan.policy]
+            * (stage_param_bytes / plan.fsdp)
+            * frac_dp * wire_f * cal["wire"]
+        )
+        ici_bytes = 2.0 * stage_param_bytes * frac_fsdp  # RS + AG
+        gather = POLICY_GATHER_HOPS[plan.policy] * stage_param_bytes * frac_fsdp
+        comm_bytes = dcn_bytes + ici_bytes + gather
+        comm_s = (
+            dcn_bytes / _bw_for(axis_bw, "dp", dcn=True)
+            + (ici_bytes + gather) / _bw_for(axis_bw, "fsdp")
+        )
+    elif w > 1:
         frac = (w - 1) / w
         grad = POLICY_GRAD_HOPS[plan.policy] * stage_param_bytes * frac
-        if plan.wire:
-            grad *= WIRE_FACTOR.get(plan.wire.partition(":")[0], 1.0)
+        grad *= wire_f
         gather = POLICY_GATHER_HOPS[plan.policy] * stage_param_bytes * frac
         comm_bytes = grad * cal["wire"] + gather
-    comm_s = comm_bytes / axis_bw
+        # a flat ring over a joint data axis moves at its slowest link
+        bw = min(
+            _bw_for(axis_bw, ax)
+            for ax, size in (("dp", plan.dp), ("fsdp", plan.fsdp))
+            if size > 1
+        )
+        slices = topology_slices(plan.topology)
+        if slices > 1 and w > plan.devices // slices:
+            # wider than one slice: the flat ring drags its FULL payload
+            # across the slice boundary — the hier twin's dcn_bytes
+            # divides this by fsdp, which is the planner's whole case
+            # for the hierarchy — and it moves at the DCN link's pace
+            dcn_bytes = comm_bytes
+            bw = min(bw, _bw_for(axis_bw, "dp", dcn=True))
+        comm_s = comm_bytes / bw
 
     bubble = analytic_bubble(plan.pp_schedule, plan.pp, plan.pp_micro, plan.pp_v)
     bubble = min(0.95, bubble * cal["bubble"])
@@ -308,6 +398,7 @@ def predict(
         "compute_s": compute_s,
         "comm_s": comm_s,
         "comm_bytes": comm_bytes,
+        "dcn_bytes": dcn_bytes,
         "bubble_fraction": bubble,
         "total_s": total_s,
     }
@@ -325,7 +416,8 @@ def rank_candidates(
     """Rank the un-pruned candidates by predicted total step time
     (stable: enumeration order — dp-major, ddp-first — breaks ties, so
     equal-cost layouts prefer the simplest spelling)."""
-    axis_bw = axis_bw or DEFAULT_AXIS_BW
+    if not axis_bw:  # None, 0, or an empty measured dict
+        axis_bw = DEFAULT_AXIS_BW
     peak = peak or _peak_flops()
     alive = [p for p in candidates if p.prune_reason is None]
     for p in alive:
@@ -380,13 +472,14 @@ def build_step(plan: Plan, batch: int | None = None):
         ZeRO2,
         ZeRO3,
         CompressedGradStep,
+        HierGradStep,
         PipelineStep,
         TrainStep,
         create_train_state,
         pipeline_state_shardings,
         stack_stage_params,
     )
-    from ..runtime.mesh import MeshSpec, make_mesh
+    from ..runtime.mesh import MeshSpec, make_hybrid_mesh, make_mesh
 
     b = batch or plan.batch
     spec = MeshSpec(dp=plan.dp, fsdp=plan.fsdp, pp=plan.pp)
@@ -395,7 +488,16 @@ def build_step(plan: Plan, batch: int | None = None):
             f"candidate needs {spec.size} devices but the backend has "
             f"{len(jax.devices())}"
         )
-    mesh = make_mesh(spec, devices=jax.devices()[: spec.size])
+    if plan.hier:
+        # the dp axis is the DCN hop: build the slice-aware layout so
+        # slice_axis(mesh) is registered and the step tiers its sync
+        mesh = make_hybrid_mesh(
+            MeshSpec(fsdp=plan.fsdp, pp=plan.pp),
+            dcn_dp=plan.dp,
+            devices=jax.devices()[: spec.size],
+        )
+    else:
+        mesh = make_mesh(spec, devices=jax.devices()[: spec.size])
     pol_kw: dict = {"min_shard_size": 1}
     if plan.remat != "none":
         pol_kw["remat"] = plan.remat
@@ -491,9 +593,13 @@ def build_step(plan: Plan, batch: int | None = None):
         tx=tx, mesh=mesh, policy=policy,
     )
     if plan.wire:
+        # on a hybrid mesh CompressedGradStep is already the two-level
+        # quantized form: f32 reduce-scatter on ICI, narrow dp hop
         step = CompressedGradStep(
             loss_fn, tx, mesh, policy, donate=False, wire=plan.wire
         )
+    elif plan.hier:
+        step = HierGradStep(loss_fn, tx, mesh, policy, donate=False)
     else:
         step = TrainStep(
             loss_fn, tx, mesh, policy, state_shardings=sh, donate=False
@@ -564,7 +670,8 @@ def search(
     tuner=None,
     calibration: dict | None = None,
     calibration_path: str | None = None,
-    axis_bw: float | None = None,
+    axis_bw=None,
+    axis_bw_source: str | None = None,
     peak: float | None = None,
     safety: float = DEFAULT_SAFETY,
     policies=DEFAULT_POLICIES,
@@ -572,6 +679,7 @@ def search(
     wires=DEFAULT_WIRES,
     schedules=DEFAULT_SCHEDULES,
     micro_factors=DEFAULT_MICRO_FACTORS,
+    hiers=DEFAULT_HIERS,
 ) -> dict:
     """Enumerate -> rank -> probe down the ranking until ``top_k``
     candidates survive the memory + static prune. Returns the plan doc.
@@ -585,6 +693,7 @@ def search(
     candidates = enumerate_candidates(
         model, topology, batch=batch, policies=policies, remats=remats,
         wires=wires, schedules=schedules, micro_factors=micro_factors,
+        hiers=hiers,
     )
     ranked = rank_candidates(
         candidates, calibration=calibration, axis_bw=axis_bw, peak=peak
@@ -656,7 +765,9 @@ def search(
         "budget_bytes": budget_bytes,
         "safety": safety,
         "top_k": top_k,
-        "axis_bandwidth": axis_bw or DEFAULT_AXIS_BW,
+        "axis_bandwidth": axis_bw if axis_bw else DEFAULT_AXIS_BW,
+        "axis_bw_source": axis_bw_source
+        or ("given" if axis_bw else "analytic"),
         "peak_flops": peak or _peak_flops(),
         "calibration_path": calibration_path,
         "calibration": {
@@ -676,14 +787,20 @@ def search(
 # -- CLI -----------------------------------------------------------------
 
 
-def _load_calibration(path: str) -> dict:
+def _load_calibration_doc(path: str) -> dict:
     """Stdlib twin of observe.opcost.load_calibration (that package
-    import would pull jax; the planner stays host-side)."""
+    import would pull jax; the planner stays host-side). Returns the
+    FULL doc — ``calibration`` ratios plus ``meta`` (which carries the
+    measured ``axis_bandwidth`` table bench.py persists)."""
     with open(path, encoding="utf-8") as fh:
         doc = json.load(fh)
     if not isinstance(doc, dict) or not isinstance(doc.get("calibration"), dict):
         raise ValueError(f"{path} is not a calibration.json (no 'calibration' table)")
-    return doc["calibration"]
+    return doc
+
+
+def _load_calibration(path: str) -> dict:
+    return _load_calibration_doc(path)["calibration"]
 
 
 def _csv(spec: str, allowed, what: str):
@@ -758,7 +875,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="tune_batch_size per survivor over the pre-built compile "
         "closure; strict refusal (no budget) prunes, never raises",
     )
-    p.add_argument("--axis-bw", type=float, default=0.0, help="bytes/s per data hop")
+    p.add_argument(
+        "--axis-bw", type=float, default=0.0,
+        help="bytes/s per data hop (0 = auto: the calibration.json's "
+        "measured meta.axis_bandwidth when present, else analytic)",
+    )
     p.add_argument("--peak-flops", type=float, default=0.0, help="per-device peak FLOP/s")
     return p
 
@@ -793,12 +914,33 @@ def main(argv=None) -> int:
         raise
 
     calibration = None
+    cal_doc = None
     if args.calibration:
         try:
-            calibration = _load_calibration(args.calibration)
+            cal_doc = _load_calibration_doc(args.calibration)
+            calibration = cal_doc["calibration"]
         except (OSError, ValueError, json.JSONDecodeError) as e:
             print(f"error: --calibration: {e}", file=sys.stderr)
             return 2
+
+    # per-axis bandwidth precedence: an explicit --axis-bw wins; else the
+    # calibration run's MEASURED meta.axis_bandwidth; else the analytic
+    # constants. Logged so a plan is never silently ranked on the wrong
+    # bandwidth source.
+    axis_bw = args.axis_bw or None
+    axis_bw_source = "flag:--axis-bw" if axis_bw else None
+    if axis_bw is None and cal_doc is not None:
+        meta_bw = (cal_doc.get("meta") or {}).get("axis_bandwidth")
+        if isinstance(meta_bw, dict):
+            measured = {
+                str(ax): float(v) for ax, v in meta_bw.items() if v
+            }
+            if measured:
+                axis_bw = measured
+                axis_bw_source = f"measured:{args.calibration}"
+    if axis_bw_source is None:
+        axis_bw_source = "analytic:defaults"
+    print(f"axis bandwidth source: {axis_bw_source}")
 
     budget_bytes = (
         int(args.budget_gb * (1 << 30)) if args.budget_gb > 0 else None
@@ -845,7 +987,8 @@ def main(argv=None) -> int:
         tuner=tuner,
         calibration=calibration,
         calibration_path=args.calibration,
-        axis_bw=args.axis_bw or None,
+        axis_bw=axis_bw,
+        axis_bw_source=axis_bw_source,
         peak=args.peak_flops or None,
         policies=policies,
         remats=remats,

@@ -66,6 +66,12 @@ def build_context(step, state, batch, lr_factor=1.0, *, static_args=(),
     # on .wire — auto-thread it so the bytes-on-wire rule sees it without
     # every caller plumbing an extra kwarg
     extra.setdefault("wire", getattr(step, "wire", None))
+    # HierGradStep carries its slice axis on .dcn_axis (fixtures may set
+    # .hier directly) — the dcn-flat-ring rule audits that claim
+    extra.setdefault(
+        "hier",
+        getattr(step, "dcn_axis", None) or getattr(step, "hier", None),
+    )
     for k, v in extra.items():
         setattr(ctx, k, v)
     return ctx

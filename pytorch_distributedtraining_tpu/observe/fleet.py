@@ -994,6 +994,9 @@ _SUMMARY_HEADLINES = {
     # record headlines the decode fast path's throughput claim — the
     # number speculative decoding exists to move.
     "serve_slo": ("decode_tokens_per_sec_spec", "tok/s"),
+    # hier_bench.py's record headlines the per-device bytes the two-level
+    # sync puts on the DCN hop — the number the hierarchy exists to shrink.
+    "hier": ("dcn_bytes", "bytes"),
 }
 
 
@@ -1032,6 +1035,8 @@ def metric_direction(rec: dict) -> str:
         or "ttft" in metric
     ):
         return "lower"
+    if unit in ("bytes", "b") or metric.endswith("_bytes"):
+        return "lower"  # wire/DCN payload gauges: growth is the regression
     return "higher"
 
 

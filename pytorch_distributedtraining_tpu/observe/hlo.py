@@ -323,6 +323,202 @@ def has_logical_reduce_scatter(hlo_text: str, shard_elems: int) -> bool:
     return any(comp in ar_fed for comp, _ in ds_comps)
 
 
+# -- hierarchical (two-level) collective audit -------------------------------
+
+_GROUPS_EXPLICIT_RE = re.compile(r"replica_groups=\{(\{[0-9, ]*\}(?:,\{[0-9, ]*\})*)\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?"
+)
+
+
+def replica_groups(line: str) -> list | None:
+    """Parse one collective's ``replica_groups`` attribute into explicit
+    id groups. Handles both HLO spellings: the literal form
+    ``{{0,1},{2,3}}`` and the iota form ``[G,S]<=[dims](T(perm))`` —
+    reshape(transpose(iota(prod(dims)), perm), (G, S)). None when the
+    line carries no parsable groups (flat/implicit grouping)."""
+    m = _GROUPS_EXPLICIT_RE.search(line)
+    if m is not None:
+        out = []
+        for grp in m.group(1).split("},{"):
+            ids = [int(t) for t in grp.strip("{} ").split(",") if t.strip()]
+            if ids:
+                out.append(ids)
+        return out or None
+    m = _GROUPS_IOTA_RE.search(line)
+    if m is not None:
+        import numpy as _np
+
+        g, s = int(m.group(1)), int(m.group(2))
+        dims = [int(t) for t in m.group(3).split(",") if t]
+        ids = _np.arange(int(_np.prod(dims))).reshape(dims)
+        if m.group(4):
+            perm = [int(t) for t in m.group(4).split(",") if t]
+            ids = ids.transpose(perm)
+        return [list(map(int, row)) for row in ids.reshape(g, s)]
+    return None
+
+
+def partition_slice_ids(mesh, dcn_axis: str) -> list:
+    """Slice (DCN) coordinate of every SPMD partition id, in order.
+
+    Partition ids follow the mesh's flattened device order (the
+    computation's device assignment), so partition ``p``'s slice is the
+    ``dcn_axis`` coordinate of flat position ``p`` in ``mesh.devices``.
+    """
+    import numpy as _np
+
+    shape = _np.asarray(mesh.devices).shape
+    ax = list(mesh.axis_names).index(dcn_axis)
+    return [
+        int(_np.unravel_index(p, shape)[ax])
+        for p in range(int(_np.prod(shape)))
+    ]
+
+
+# collective kinds that carry gradient payload during a sync (all-gather
+# re-assembles the scattered shard; collective-permute never reduces)
+_REDUCE_KINDS = frozenset({"all-reduce", "reduce-scatter", "all-to-all"})
+
+
+@dataclass(frozen=True)
+class HierarchyFinding:
+    """One collective classified against the slice boundary."""
+
+    kind: str
+    dtype: str
+    elems: int        # per-partition result elements (tuple members summed)
+    crossing: bool    # replica groups span >= 2 slices
+    grouped: bool     # replica_groups were parsable (False = implicit/flat)
+    line: str
+
+    def __repr__(self) -> str:  # keep pytest output readable
+        where = "dcn" if self.crossing else "ici"
+        return f"HierarchyFinding({self.kind}, {self.dtype}, {self.elems}, {where})"
+
+
+@dataclass(frozen=True)
+class HierarchyAudit:
+    """Verdict: do the DCN crossings carry only reduce-scattered bytes?
+
+    The two-level contract: with a within-slice (ICI) axis of size k, any
+    collective whose replica groups cross the slice boundary must operate
+    on at most ``ceil(grad_elems / k)`` elements (+ one k of padding per
+    op) — the payload AFTER the within-slice reduce-scatter. A crossing
+    collective at full ``grad_elems`` is a flat ring over DCN, the exact
+    pattern :func:`hierarchy_audit` exists to reject. ``dcn_bytes`` sums
+    the per-partition bytes of every crossing collective — the number the
+    hier bench publishes against its flat twin.
+    """
+
+    dcn_axis: str
+    ici_size: int
+    grad_elems: int
+    findings: tuple
+
+    @property
+    def crossing(self) -> tuple:
+        return tuple(f for f in self.findings if f.crossing)
+
+    @property
+    def max_crossing_elems(self) -> int:
+        return max((f.elems for f in self.crossing), default=0)
+
+    @property
+    def dcn_bytes(self) -> int:
+        from .opcost import dtype_bytes
+
+        return sum(f.elems * dtype_bytes(f.dtype) for f in self.crossing)
+
+    @property
+    def shard_elems_bound(self) -> int:
+        """Largest f32 payload one DCN crossing may carry: the
+        reduce-scattered shard plus a padding allowance (buckets pad to
+        the ICI width)."""
+        if self.ici_size <= 1:
+            return self.grad_elems
+        return -(-self.grad_elems // self.ici_size) + self.ici_size
+
+    @property
+    def flat_rings(self) -> tuple:
+        """Crossing reduce collectives that exceed the scattered-shard
+        *bytes* (``shard_elems_bound`` x 4). The bound is byte-
+        denominated because DCN cares about bytes: a quantized wire's
+        crossing (``CompressedGradStep``'s s8/f8 all-to-all runs at full
+        element count but 1/4 the width) is the hierarchy's narrow form,
+        not a flat ring — while an f32 ring at full size always trips."""
+        from .opcost import dtype_bytes
+
+        bound_bytes = self.shard_elems_bound * 4
+        return tuple(
+            f
+            for f in self.crossing
+            if f.kind in _REDUCE_KINDS
+            and f.elems * dtype_bytes(f.dtype) > bound_bytes
+        )
+
+    @property
+    def ok(self) -> bool:
+        """True when no DCN crossing exceeds the reduce-scattered bound.
+
+        Vacuously true on a single-slice mesh (nothing crosses) and for
+        modules with no parsable crossing collectives.
+        """
+        return not self.flat_rings
+
+
+def hierarchy_audit(
+    hlo_text: str, mesh, *, grad_elems: int, dcn_axis: str | None = None
+) -> HierarchyAudit:
+    """Classify a compiled step's collectives against the slice boundary.
+
+    ``grad_elems`` is the total gradient element count of the step (sum
+    over param leaves) — the payload a flat dp ring would carry in one
+    crossing. ``dcn_axis`` defaults to the mesh's registered slice axis
+    (:func:`runtime.mesh.slice_axis`); a mesh without one has no slice
+    boundary and audits vacuously clean. Collectives whose
+    ``replica_groups`` are unparsable/implicit span ALL partitions and
+    are conservatively classed as crossing when the mesh has >1 slice.
+    """
+    if dcn_axis is None:
+        from ..runtime.mesh import slice_axis as _slice_axis
+
+        dcn_axis = _slice_axis(mesh)
+    findings: list[HierarchyFinding] = []
+    if dcn_axis is None:
+        return HierarchyAudit(
+            dcn_axis="", ici_size=1, grad_elems=int(grad_elems), findings=()
+        )
+    slices = partition_slice_ids(mesh, dcn_axis)
+    n_slices = len(set(slices))
+    ici_size = 1
+    for a in mesh.axis_names:
+        if a != dcn_axis and a in ("dp", "fsdp"):
+            ici_size *= int(mesh.shape.get(a, 1))
+    for w in wire_inventory(hlo_text):
+        groups = replica_groups(w.line)
+        if groups is None:
+            crossing = n_slices > 1
+            grouped = False
+        else:
+            crossing = any(
+                len({slices[i] for i in grp if i < len(slices)}) > 1
+                for grp in groups
+            )
+            grouped = True
+        findings.append(
+            HierarchyFinding(
+                w.kind, w.dtype, w.elems, crossing, grouped, w.line
+            )
+        )
+    return HierarchyAudit(
+        dcn_axis=dcn_axis,
+        ici_size=ici_size,
+        grad_elems=int(grad_elems),
+        findings=tuple(findings),
+    )
+
+
 def counts(hlo_text: str) -> dict[str, int]:
     """{kind: occurrences} — the one-line summary used by benchmarks."""
     agg: dict[str, int] = {}

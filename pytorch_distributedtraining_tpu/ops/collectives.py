@@ -253,3 +253,61 @@ def tree_all_reduce(tree, axis_name: str = "dp", op: str = "mean"):
     `torch/nn/parallel/distributed.py:1298`)."""
     fn = functools.partial(all_reduce, axis_name=axis_name, op=op)
     return jax.tree.map(fn, tree)
+
+
+# -- two-level (hierarchical) forms ------------------------------------------
+#
+# On a hybrid ICI x DCN mesh (runtime.mesh.make_hybrid_mesh) a flat ring
+# over the data axes ships FULL gradient payloads across the slow DCN
+# links. The two-level form reduce-scatters within the slice first (fast
+# ICI, each device ends up owning 1/ici_size of the payload), all-reduces
+# only that owned shard across slices (the DCN hop carries 1/ici_size of
+# the bytes), then all-gathers within the slice. Same result, DCN volume
+# divided by the within-slice axis size. parallel/hierarchy.py builds the
+# bucketed grad-sync strategy on these primitives.
+
+
+def hier_all_reduce(
+    x, *, ici_axis: str | None, dcn_axis: str, op: str = "sum"
+):
+    """Two-level all-reduce for shard_map interiors.
+
+    ``reduce-scatter(ici) -> all-reduce(dcn) -> all-gather(ici)`` on a
+    flattened view of ``x`` (the scatter needs an even split, so the
+    payload is zero-padded to a multiple of the ICI axis size and the
+    pad is stripped after the gather). ``ici_axis=None`` — a pure-DCN
+    mesh, nothing to scatter within — degenerates to the flat
+    single-axis reduce, which IS the hierarchical form at ici size 1.
+    """
+    if op not in ("sum", "mean"):
+        raise ValueError(f"hier_all_reduce supports sum|mean, got {op!r}")
+    if ici_axis is None:
+        out = lax.psum(x, dcn_axis)
+        if op == "mean":
+            out = out / _axis_size(dcn_axis)
+        return out
+    n_ici = int(_axis_size(ici_axis))
+    flat = x.reshape(-1)
+    pad = (-flat.size) % n_ici
+    flat = jnp.pad(flat, (0, pad))
+    shard = lax.psum_scatter(flat, ici_axis, scatter_dimension=0, tiled=True)
+    shard = lax.psum(shard, dcn_axis)  # 1/ici_size payload on the DCN hop
+    full = lax.all_gather(shard, ici_axis, axis=0, tiled=True)
+    if pad:
+        full = full[:-pad]
+    out = full.reshape(x.shape)
+    if op == "mean":
+        out = out / (n_ici * _axis_size(dcn_axis))
+    return out
+
+
+def tree_hier_all_reduce(
+    tree, *, ici_axis: str | None, dcn_axis: str, op: str = "mean"
+):
+    """Two-level :func:`tree_all_reduce`: every leaf through
+    :func:`hier_all_reduce`. Leaf-at-a-time (unbucketed) — the bucketed
+    strategy that coalesces small leaves lives in parallel/hierarchy.py."""
+    fn = functools.partial(
+        hier_all_reduce, ici_axis=ici_axis, dcn_axis=dcn_axis, op=op
+    )
+    return jax.tree.map(fn, tree)

@@ -37,6 +37,16 @@ from .compressed import (
     WireFormat,
     wire_format,
 )
+from .hierarchy import (
+    BucketPlan,
+    DegradeDecision,
+    HierGradStep,
+    SliceDegradeController,
+    bucket_bytes_for,
+    exclude_slice,
+    plan_buckets,
+    resolve_axis_bandwidth,
+)
 from .tensor import MEGATRON_RULES, TensorParallel, tp_zero1, tp_zero3
 from .pipeline import (
     SCHEDULES,
@@ -79,6 +89,14 @@ __all__ = [
     "WIRE_FORMATS",
     "WireFormat",
     "wire_format",
+    "BucketPlan",
+    "DegradeDecision",
+    "HierGradStep",
+    "SliceDegradeController",
+    "bucket_bytes_for",
+    "exclude_slice",
+    "plan_buckets",
+    "resolve_axis_bandwidth",
     "MEGATRON_RULES",
     "TensorParallel",
     "tp_zero1",

@@ -57,6 +57,11 @@ Named sites (each threaded into the layer that owns it):
                        decode state out — ``raise`` forces the replay
                        path instead of the migrate path
                        (``serve/fleet.py``)
+``comm.dcn``           the inter-slice (DCN) gradient sync is about to
+                       dispatch — ``sleep`` models a degraded DCN link
+                       stretching every two-level sync; the slow-slice
+                       degradation drill rides this
+                       (``parallel/hierarchy.py``)
 =====================  =====================================================
 
 A plan is JSON — inline in ``GRAFT_FAULT_PLAN`` or a file path — so it
@@ -119,6 +124,7 @@ SITES = frozenset({
     "route.dispatch",
     "replica.kill",
     "replica.drain",
+    "comm.dcn",
 })
 
 

@@ -37,6 +37,48 @@ except ImportError:  # pragma: no cover
 
 AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "tp", "ep")
 
+# id(mesh) -> (mesh, name of its DCN/slice axis). Populated by
+# make_hybrid_mesh; queried through slice_axis() so callers never
+# string-match "dp". NOTE: jax interns Mesh — constructing an equal
+# (devices, axis_names) layout returns the SAME object — so the
+# registration is effectively per physical layout, which is the right
+# semantics: the slice structure is a property of the devices, not of
+# which builder you called. Consumers that must distinguish "this step
+# MEANT to be hierarchical" (e.g. the dcn-flat-ring rule) gate on a
+# step-level claim, not on this registry alone. The stored mesh ref
+# keeps the id live; bounded FIFO (meshes are tiny, tests build
+# hundreds).
+_SLICE_AXES: dict = {}
+_SLICE_AXES_CAP = 128
+
+
+def _register_slice_axis(mesh: "Mesh", axis: str) -> None:
+    while len(_SLICE_AXES) >= _SLICE_AXES_CAP:
+        _SLICE_AXES.pop(next(iter(_SLICE_AXES)))
+    _SLICE_AXES[id(mesh)] = (mesh, axis)
+
+
+def slice_axis(mesh: "Mesh") -> str | None:
+    """The mesh axis that crosses slice (DCN) boundaries, or None.
+
+    Only hybrid meshes built by :func:`make_hybrid_mesh` with more than
+    one slice have a slice axis; a single-slice mesh (every link is ICI)
+    returns None. This is the one sanctioned way to ask "which axis is
+    the slow hop" — parallel/hierarchy.py, the dcn-flat-ring graftcheck
+    rule and the facade all route through it instead of assuming "dp".
+    Because jax interns Mesh, an equal layout rebuilt by hand IS the
+    registered object and inherits the slice axis — the slice structure
+    belongs to the physical devices, not to the builder call.
+    """
+    entry = _SLICE_AXES.get(id(mesh))
+    return entry[1] if entry is not None else None
+
+
+def ici_data_axes(mesh: "Mesh") -> tuple:
+    """Data axes that stay within a slice (the fast, within-ICI hops)."""
+    dcn = slice_axis(mesh)
+    return tuple(a for a in data_axes(mesh) if a != dcn)
+
 
 @dataclass(frozen=True)
 class MeshSpec:
@@ -152,7 +194,9 @@ def make_hybrid_mesh(
         dev_array = mesh_utils.create_hybrid_device_mesh(
             ici_shape, dcn_shape, devices=devices
         )
-        return Mesh(dev_array, names)
+        mesh = Mesh(dev_array, names)
+        _register_slice_axis(mesh, "dp")
+        return mesh
     if on_tpu:  # multi-slice TPU without the slice-aware builder
         warnings.warn(
             "mesh_utils.create_hybrid_device_mesh unavailable: hybrid mesh "
@@ -165,7 +209,9 @@ def make_hybrid_mesh(
     rest = tuple(getattr(spec, n) for n in names if n != "dp")
     arr = np.asarray(devices).reshape((dcn_dp,) + rest)
     arr = np.moveaxis(arr, 0, names.index("dp"))
-    return Mesh(arr, names)
+    mesh = Mesh(arr, names)
+    _register_slice_axis(mesh, "dp")
+    return mesh
 
 
 def best_mesh(n: int | None = None, *, zero: bool = False) -> Mesh:

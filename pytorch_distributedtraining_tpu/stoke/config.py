@@ -101,6 +101,16 @@ class TPUConfig:
     # :BLOCK suffix) routes the fused step through CompressedGradStep;
     # None/"" keeps TrainStep's f32 collectives. Env twin: $GRAFT_WIRE.
     wire: str | None = None
+    # Hierarchical (two-level) gradient sync (parallel/hierarchy.py):
+    # build the mesh slice-aware (dp rides DCN via make_hybrid_mesh) and
+    # route the fused step through HierGradStep — reduce-scatter within
+    # the slice on ICI, all-reduce the 1/ici shard across slices on DCN,
+    # all-gather back. Composes with ``wire``: a quantized wire keeps
+    # CompressedGradStep, which on a hybrid mesh already narrows only
+    # the DCN hop. Needs dp >= 2 (slices) and fsdp >= 2 (a within-slice
+    # axis); incompatible combinations warn and fall back to the flat
+    # sync. Env twin: $GRAFT_HIER.
+    hier: bool = False
     # fp8 matmul compute ("e4m3" | "e5m2" — precision.fp8_dot_general_cls):
     # cloned onto models whose cfg carries an ``fp8`` field (GPT-2/ViT).
     # Env twin: $GRAFT_FP8.

@@ -40,6 +40,7 @@ from ..data import DataLoader as _DataLoader
 from ..ops import sync_scalar_device
 from ..parallel import (
     CompressedGradStep,
+    HierGradStep,
     TrainStep,
     create_train_state,
     policy_from_flags,
@@ -49,7 +50,13 @@ from ..parallel.remat import apply_remat, resolve_remat
 from ..parallel.spec import constrain, shard_axis, stream_to_device
 from ..precision import DynamicLossScaler, Policy as PrecisionPolicy
 from ..runtime import dist as _dist
-from ..runtime.mesh import MeshSpec, batch_spec, make_mesh
+from ..runtime.mesh import (
+    MeshSpec,
+    batch_spec,
+    make_hybrid_mesh,
+    make_mesh,
+    slice_axis,
+)
 from .config import (
     AMPConfig,
     ClipGradConfig,
@@ -119,6 +126,15 @@ def _wire_from_env(cfg):
     construction, not mid-training."""
     spec = os.environ.get("GRAFT_WIRE", cfg.wire)
     return wire_format(spec)
+
+
+def _hier_from_env(cfg):
+    """Resolve the two-level gradient sync: ``$GRAFT_HIER`` overrides
+    ``TPUConfig.hier`` (same env-twin pattern as GRAFT_WIRE)."""
+    env = os.environ.get("GRAFT_HIER")
+    if env is not None:
+        return env.strip().lower() not in ("", "0", "false", "off", "no")
+    return bool(cfg.hier)
 
 
 def _apply_fp8_env(model, cfg):
@@ -577,6 +593,10 @@ class Stoke:
         # low-precision knobs (env > TPUConfig): quantized gradient wire
         # and the fp8 matmul mode for models that implement it
         self.wire = _wire_from_env(self.tpu_config)
+        # two-level grad sync (env > TPUConfig): slice-aware mesh + a
+        # tiered fused step; composes with the wire (only the DCN hop
+        # is quantized on a hybrid mesh)
+        self.hier = _hier_from_env(self.tpu_config)
         self._module, self.fp8 = _apply_fp8_env(
             self._module, self.tpu_config
         )
@@ -729,6 +749,16 @@ class Stoke:
         if mesh is not None:
             self.mesh = mesh
             self.pp = self.mesh.shape.get("pp", 1)
+            if self.hier and slice_axis(self.mesh) is None:
+                import warnings
+
+                warnings.warn(
+                    "hier requested but the provided mesh has no slice "
+                    "axis (build it with make_hybrid_mesh) — falling "
+                    "back to the flat gradient sync",
+                    stacklevel=2,
+                )
+                self.hier = False
         elif (
             self.tpu_config.dp
             or self.tpu_config.fsdp > 1
@@ -743,16 +773,43 @@ class Stoke:
                     * self.tpu_config.sp * self.pp
                 )
                 dp = max(1, jax.device_count() // used)
-            self.mesh = make_mesh(
-                MeshSpec(
-                    dp=dp or 1,
-                    fsdp=self.tpu_config.fsdp,
-                    tp=self.tpu_config.tp,
-                    sp=self.tpu_config.sp,
-                    pp=self.pp,
-                )
+            spec = MeshSpec(
+                dp=dp or 1,
+                fsdp=self.tpu_config.fsdp,
+                tp=self.tpu_config.tp,
+                sp=self.tpu_config.sp,
+                pp=self.pp,
             )
+            if self.hier and (dp or 1) >= 2:
+                # the dp axis is the DCN hop: slice-aware layout so the
+                # fused step can tier its sync over slice_axis(mesh)
+                self.mesh = make_hybrid_mesh(
+                    dataclasses.replace(spec, dp=1), dcn_dp=dp
+                )
+            else:
+                if self.hier:
+                    import warnings
+
+                    warnings.warn(
+                        "hier requested but dp < 2 (no slice boundary "
+                        "to tier over) — falling back to the flat "
+                        "gradient sync",
+                        stacklevel=2,
+                    )
+                    self.hier = False
+                self.mesh = make_mesh(spec)
         else:
+            if self.hier:
+                import warnings
+
+                warnings.warn(
+                    "hier requested but no mesh axes were configured "
+                    "(set TPUConfig.dp>=2 and fsdp>=2, or pass a "
+                    "make_hybrid_mesh mesh) — falling back to the flat "
+                    "gradient sync",
+                    stacklevel=2,
+                )
+                self.hier = False
             self.mesh = make_mesh(MeshSpec.zero() if zero else MeshSpec.ddp())
         if self._plan is not None:
             # publish the applied plan into analyze.plan.runtime_stats and
@@ -855,12 +912,20 @@ class Stoke:
                 "mutually exclusive: the wire quantizes per leaf, the "
                 "fused update ravels grads flat — drop one of the two"
             )
-        # auto mode defers to a requested wire: CompressedGradStep is a
-        # per-leaf path, so the flat fused update cannot carry it
+        if fused_optimizer is True and self.hier:
+            raise ValueError(
+                "fused_optimizer=True and hier are mutually exclusive: "
+                "HierGradStep drives an optax-style per-leaf update; "
+                "the fused update ravels grads flat — drop one of the two"
+            )
+        # auto mode defers to a requested wire or the two-level sync:
+        # CompressedGradStep/HierGradStep are per-leaf paths, so the
+        # flat fused update cannot carry them
         if (
             fused_eligible
             and fused_optimizer is not False
             and self.wire is None
+            and not self.hier
         ):
             self._tx = optim_mod.FusedAdamW(lr=1.0, **kwargs)
         else:
@@ -1534,6 +1599,46 @@ class Stoke:
                 f"wire={self.wire.name!r} requested but the fused step "
                 f"does not compose with {reason}; falling back to "
                 "TrainStep's f32 gradient wire",
+                stacklevel=2,
+            )
+
+        if self.hier and self.wire is None:
+            # two-level f32 sync: HierGradStep owns the whole reduce
+            # path (reduce-scatter on ICI -> all-reduce across slices on
+            # DCN -> all-gather), so the same TrainStep extras the wire
+            # path refuses (accum windows, loss scaler, precision casts,
+            # pipelining) fall back to the flat sync out loud. The
+            # wire+hier composition took the CompressedGradStep branch
+            # above — on a hybrid mesh it is already the two-level
+            # quantized form.
+            reason = None
+            if self.grad_accum_steps > 1:
+                reason = "grad_accum_steps > 1"
+            elif self.loss_scaler is not None:
+                reason = "the dynamic fp16 loss scaler"
+            elif self.fp16 is not None:
+                reason = f"the {self.fp16!r} precision policy"
+            elif self.pp > 1:
+                reason = "pipeline parallelism"
+            if reason is None:
+                try:
+                    self._fused = HierGradStep(
+                        loss_fn,
+                        self._tx,
+                        self.mesh,
+                        self.policy,
+                        donate=self.tpu_config.donate_state,
+                        numerics=self.numerics_probe,
+                    )
+                    return self._fused
+                except ValueError as e:  # ZeRO-3 / non-data mesh axes
+                    reason = str(e)
+            import warnings
+
+            warnings.warn(
+                f"hier requested but the fused step does not compose "
+                f"with {reason}; falling back to TrainStep's flat "
+                "gradient sync",
                 stacklevel=2,
             )
 

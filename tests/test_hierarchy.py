@@ -1,0 +1,616 @@
+"""Hierarchical bandwidth-aware grad sync (parallel/hierarchy.py).
+
+Covers the two-level collective numerics (== flat, on hybrid and
+pure-DCN meshes), measured-bandwidth bucket sizing, the HLO hierarchy
+audit (two-level passes, a seeded flat DCN ring fails), wire x hier
+composition, the slow-slice degradation drill, the hybrid-mesh slice
+layout regression, planner ranking on measured per-axis bandwidths, and
+the GRAFT_PLAN hier round-trip through the facade apply path.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pytorch_distributedtraining_tpu import ops, optim
+from pytorch_distributedtraining_tpu.ops.collectives import (
+    hier_all_reduce,
+    shard_map,
+)
+from pytorch_distributedtraining_tpu.parallel import (
+    DDP,
+    ZeRO2,
+    ZeRO3,
+    HierGradStep,
+    SliceDegradeController,
+    TrainStep,
+    create_train_state,
+    exclude_slice,
+    plan_buckets,
+)
+from pytorch_distributedtraining_tpu.parallel.hierarchy import (
+    ANALYTIC_DCN_BW,
+    ANALYTIC_ICI_BW,
+    MAX_BUCKET_BYTES,
+    MIN_BUCKET_BYTES,
+    bucket_bytes_for,
+    resolve_axis_bandwidth,
+)
+from pytorch_distributedtraining_tpu.runtime.mesh import (
+    MeshSpec,
+    make_hybrid_mesh,
+    make_mesh,
+    slice_axis,
+)
+
+
+@pytest.fixture()
+def hybrid_mesh(devices8):
+    """2 slices x 4-wide ICI: dp is the DCN crossing, fsdp stays inside."""
+    return make_hybrid_mesh(MeshSpec(fsdp=4), dcn_dp=2, devices=devices8)
+
+
+def _mlp_problem(dim=16):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(16, dim)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(16, 1)).astype(np.float32))
+
+    def init_fn(r):
+        k1, k2 = jax.random.split(r)
+        return {
+            "w1": jax.random.normal(k1, (dim, 2 * dim)) * 0.1,
+            "b1": jnp.zeros((2 * dim,)),
+            "out": jax.random.normal(k2, (2 * dim, 1)) * 0.1,
+        }, {}
+
+    def loss_fn(params, batch, rng_, ms):
+        xb, yb = batch
+        h = jnp.tanh(xb @ params["w1"] + params["b1"])
+        return jnp.mean((h @ params["out"] - yb) ** 2), {}
+
+    return init_fn, loss_fn, (x, y)
+
+
+# -- make_hybrid_mesh layout + slice_axis --------------------------------
+
+
+def test_hybrid_mesh_slices_are_contiguous(devices8, hybrid_mesh):
+    """Regression: the DCN axis must be OUTERMOST in the reshape — slice
+    s is devices [s*ici, (s+1)*ici), a physically co-located block, not
+    an interleaved stride (which would put ICI traffic on DCN links)."""
+    assert slice_axis(hybrid_mesh) == "dp"
+    dp_idx = hybrid_mesh.axis_names.index("dp")
+    devs = np.asarray(hybrid_mesh.devices)
+    assert devs.shape[dp_idx] == 2
+    for s in range(2):
+        got = list(np.take(devs, s, axis=dp_idx).ravel())
+        assert got == list(devices8[s * 4:(s + 1) * 4]), (
+            f"slice {s} is not a contiguous device block"
+        )
+
+
+def test_slice_axis_absent_on_plain_mesh(devices8):
+    # a layout no hybrid builder ever registered (jax interns Mesh, so
+    # this must be a layout distinct from every make_hybrid_mesh call)
+    mesh = make_mesh(MeshSpec(fsdp=8), devices=devices8)
+    assert slice_axis(mesh) is None
+    # dcn_dp=1 means no slice boundary: delegates, stays unregistered
+    same = make_hybrid_mesh(MeshSpec(fsdp=8), dcn_dp=1, devices=devices8)
+    assert slice_axis(same) is None
+
+
+# -- two-level collective numerics ---------------------------------------
+
+
+@pytest.mark.parametrize("op", ["sum", "mean"])
+def test_hier_all_reduce_matches_flat(hybrid_mesh, op):
+    # 5 elements/device: NOT a multiple of the ICI width 4, so the
+    # scatter's zero-pad + unpad path is exercised
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(8, 5)).astype(np.float32)
+
+    def run(fn):
+        f = shard_map(
+            fn, mesh=hybrid_mesh, in_specs=(P(("dp", "fsdp")),),
+            out_specs=P(("dp", "fsdp")), check_vma=False,
+        )
+        arr = jax.device_put(
+            x, NamedSharding(hybrid_mesh, P(("dp", "fsdp")))
+        )
+        return np.asarray(jax.jit(f)(arr))
+
+    two_level = run(
+        lambda v: hier_all_reduce(v, ici_axis="fsdp", dcn_axis="dp", op=op)
+    )
+    flat = run(
+        lambda v: ops.all_reduce(ops.all_reduce(v, "fsdp", op), "dp", op)
+    )
+    np.testing.assert_allclose(two_level, flat, rtol=1e-6, atol=1e-6)
+
+
+def test_hier_all_reduce_pure_dcn_degenerates_to_flat(mesh8):
+    """ici_axis=None (every device its own slice): the hierarchy IS the
+    flat reduce — nothing inside a slice to scatter over."""
+    x = np.arange(8.0, dtype=np.float32)[:, None]
+
+    def run(fn):
+        f = shard_map(
+            fn, mesh=mesh8, in_specs=(P("dp"),), out_specs=P("dp"),
+            check_vma=False,
+        )
+        return np.asarray(
+            jax.jit(f)(jax.device_put(x, NamedSharding(mesh8, P("dp"))))
+        )
+
+    two_level = run(
+        lambda v: hier_all_reduce(v, ici_axis=None, dcn_axis="dp", op="sum")
+    )
+    flat = run(lambda v: ops.all_reduce(v, "dp", "sum"))
+    np.testing.assert_allclose(two_level, flat)
+
+
+@pytest.mark.parametrize("policy_cls", [DDP, ZeRO2])
+def test_hier_step_matches_flat_step(hybrid_mesh, policy_cls):
+    """The two-level sync is a reassociation of the same mean: after two
+    optimizer steps the params must match TrainStep's flat sync (tight
+    allclose, not bitwise — bucket coalescing reorders small-leaf
+    summation)."""
+    init_fn, loss_fn, batch = _mlp_problem()
+    tx = optim.adamw(lr=1e-2)
+
+    def two_steps(step_cls, **kw):
+        state, sh = create_train_state(
+            init_fn=init_fn, tx=tx, mesh=hybrid_mesh, policy=policy_cls()
+        )
+        step = step_cls(loss_fn, tx, hybrid_mesh, policy_cls(), **kw)
+        with hybrid_mesh:
+            for _ in range(2):
+                state, metrics = step(state, batch)
+        return state.params, float(metrics["loss"])
+
+    flat_params, flat_loss = two_steps(
+        TrainStep, extra_metrics=False, donate=False
+    )
+    hier_params, hier_loss = two_steps(HierGradStep)
+    assert np.isfinite(flat_loss) and flat_loss == pytest.approx(hier_loss)
+    for a, b in zip(
+        jax.tree.leaves(flat_params), jax.tree.leaves(hier_params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )
+
+
+def test_hier_step_dcn_cost_is_ici_fraction(hybrid_mesh):
+    init_fn, loss_fn, batch = _mlp_problem()
+    tx = optim.adamw(lr=1e-2)
+    state, _ = create_train_state(
+        init_fn=init_fn, tx=tx, mesh=hybrid_mesh, policy=DDP()
+    )
+    step = HierGradStep(loss_fn, tx, hybrid_mesh, DDP())
+    cost = step.dcn_cost(state.params)
+    assert cost["ici_size"] == 4
+    # the DCN hop carries the reduce-scattered shard: ~1/4 of the flat
+    # twin, padding to the ICI width allowed per leaf
+    n_leaves = len(jax.tree.leaves(state.params))
+    assert cost["dcn_bytes"] <= (
+        cost["dcn_bytes_flat_twin"] // 4 + n_leaves * 4 * 4
+    )
+    assert cost["dcn_bytes"] < cost["dcn_bytes_flat_twin"]
+
+
+def test_hier_step_rejections(hybrid_mesh, devices8):
+    init_fn, loss_fn, _ = _mlp_problem()
+    tx = optim.adamw(lr=1e-2)
+    # ZeRO3's sharded params belong to TrainStep's gather scheduling
+    with pytest.raises(ValueError, match="ZeRO-?3|shard"):
+        HierGradStep(loss_fn, tx, hybrid_mesh, ZeRO3())
+    # a mesh without a slice axis has no hierarchy to tier over
+    flat_mesh = make_mesh(MeshSpec(fsdp=8), devices=devices8)
+    with pytest.raises(ValueError, match="slice"):
+        HierGradStep(loss_fn, tx, flat_mesh, DDP())
+    # FusedAdamW ravels grads flat; the bucketed sync is per-leaf
+    with pytest.raises(ValueError, match="optax|Fused"):
+        HierGradStep(
+            loss_fn, optim.FusedAdamW(lr=1e-2), hybrid_mesh, DDP()
+        )
+
+
+# -- bucket sizing from measured bandwidth -------------------------------
+
+
+def test_bucket_bytes_clamp_truth_table():
+    # in-band: target = bytes/s x overlap window
+    assert bucket_bytes_for(1e9, 5e-3) == 5_000_000
+    # slow link -> floor (latency-bound below ~256 KiB)
+    assert bucket_bytes_for(1e3, 5e-3) == MIN_BUCKET_BYTES
+    # fast link -> ceiling (one giant bucket would serialize the sync)
+    assert bucket_bytes_for(1e12, 1.0) == MAX_BUCKET_BYTES
+
+
+def test_plan_buckets_against_fake_bandwidths():
+    params = {
+        "a": jnp.zeros((100_000,)),   # 400 000 B
+        "b": jnp.zeros((100_000,)),   # 400 000 B
+        "c": jnp.zeros((10,)),        # 40 B
+    }
+    # target 512 KiB: a fills one bucket, b+c coalesce into the next
+    plan = plan_buckets(params, bytes_per_s=float(1 << 19), overlap_s=1.0)
+    assert plan.source == "given"
+    assert plan.target_bytes == 1 << 19
+    assert plan.buckets == ((0,), (1, 2))
+    # slow DCN -> floor-sized buckets: every large leaf rides alone
+    slow = plan_buckets(params, bytes_per_s=1.0, overlap_s=1.0)
+    assert slow.target_bytes == MIN_BUCKET_BYTES
+    assert slow.buckets == ((0,), (1,), (2,))
+    # fast DCN -> ceiling: everything coalesces into one collective
+    fast = plan_buckets(params, bytes_per_s=1e15, overlap_s=1.0)
+    assert fast.target_bytes == MAX_BUCKET_BYTES
+    assert fast.buckets == ((0, 1, 2),)
+    # include() filters leaves out of the bucketed path (ZeRO-2 scatter)
+    only_bc = plan_buckets(
+        params, bytes_per_s=1e15, overlap_s=1.0,
+        include=lambda i, leaf: i != 0,
+    )
+    assert only_bc.buckets == ((1, 2),)
+    assert "bucket" in fast.describe()
+
+
+def test_resolve_axis_bandwidth_source_chain(tmp_path, monkeypatch):
+    from pytorch_distributedtraining_tpu.observe import opcost
+
+    monkeypatch.delenv("GRAFT_CALIBRATION", raising=False)
+    monkeypatch.setitem(opcost.runtime_stats, "axis_bandwidth", {})
+    # no measurement anywhere -> analytic constants, by link kind
+    assert resolve_axis_bandwidth("dp") == (ANALYTIC_DCN_BW, "analytic")
+    assert resolve_axis_bandwidth("fsdp", is_dcn=False) == (
+        ANALYTIC_ICI_BW, "analytic",
+    )
+    # calibration.json's meta.axis_bandwidth beats the constant
+    cal = tmp_path / "calibration.json"
+    cal.write_text(json.dumps(
+        {"meta": {"axis_bandwidth": {"dp": 1.5e9}}}
+    ))
+    assert resolve_axis_bandwidth("dp", calibration=str(cal)) == (
+        1.5e9, "calibration",
+    )
+    # ...and $GRAFT_CALIBRATION is the same path's env spelling
+    monkeypatch.setenv("GRAFT_CALIBRATION", str(cal))
+    assert resolve_axis_bandwidth("dp") == (1.5e9, "calibration")
+    # a live opcost gauge (this process measured it) beats both
+    monkeypatch.setitem(
+        opcost.runtime_stats, "axis_bandwidth", {"dp": 2.2e9}
+    )
+    assert resolve_axis_bandwidth("dp") == (2.2e9, "measured")
+
+
+# -- HLO hierarchy audit -------------------------------------------------
+
+
+def test_audit_passes_two_level_and_fails_flat_ring(hybrid_mesh):
+    from pytorch_distributedtraining_tpu.observe.hlo import hierarchy_audit
+
+    init_fn, loss_fn, batch = _mlp_problem()
+    tx = optim.adamw(lr=1e-2)
+    state, _ = create_train_state(
+        init_fn=init_fn, tx=tx, mesh=hybrid_mesh, policy=DDP()
+    )
+    grad_elems = sum(
+        int(np.prod(l.shape)) for l in jax.tree.leaves(state.params)
+    )
+    step = HierGradStep(loss_fn, tx, hybrid_mesh, DDP())
+    audit = hierarchy_audit(
+        step.compiled_text(state, batch), hybrid_mesh, grad_elems=grad_elems
+    )
+    assert audit.ok, audit.flat_rings
+    assert audit.max_crossing_elems <= audit.shard_elems_bound
+
+    # the anti-pattern: a full-size reduce whose groups span both slices
+    def flat_ring(g):
+        return lax.psum(lax.psum(g, "fsdp"), "dp")
+
+    f = shard_map(
+        flat_ring, mesh=hybrid_mesh, in_specs=(P(),), out_specs=P(),
+        check_vma=False,
+    )
+    with hybrid_mesh:
+        txt = jax.jit(f).lower(jnp.ones((512, 16))).compile().as_text()
+    bad = hierarchy_audit(txt, hybrid_mesh, grad_elems=512 * 16)
+    assert not bad.ok and bad.flat_rings
+
+
+def test_wire_composes_with_hier_on_hybrid_mesh(hybrid_mesh):
+    """GRAFT_WIRE x GRAFT_HIER: CompressedGradStep on a hybrid mesh
+    quantizes ONLY the DCN hop — HLO-proven: no crossing collective
+    exceeds the reduce-scattered bound, and the wire bytes undercut the
+    f32 twin."""
+    from pytorch_distributedtraining_tpu.observe.hlo import hierarchy_audit
+    from pytorch_distributedtraining_tpu.parallel import CompressedGradStep
+
+    # dim=64: the weight leaves clear MIN_WIRE_ELEMS, so the wire
+    # actually quantizes (tiny leaves ride f32 by design)
+    init_fn, loss_fn, batch = _mlp_problem(dim=64)
+    tx = optim.adamw(lr=1e-2)
+    state, _ = create_train_state(
+        init_fn=init_fn, tx=tx, mesh=hybrid_mesh, policy=DDP()
+    )
+    step = CompressedGradStep(
+        loss_fn, tx, hybrid_mesh, DDP(), axis_name="dp", wire="int8_block"
+    )
+    cost = step.wire_cost(state.params)
+    assert cost["wire_bytes"] < cost["fp32_bytes"]
+    grad_elems = sum(
+        int(np.prod(l.shape)) for l in jax.tree.leaves(state.params)
+    )
+    audit = hierarchy_audit(
+        step.compiled_text(state, batch), hybrid_mesh, grad_elems=grad_elems
+    )
+    assert audit.ok, audit.flat_rings
+    # the quantized hop really crosses: the int8 wire rides the slice
+    # boundary, and its bytes stay under the scattered-f32 bound
+    assert any(f.dtype == "s8" for f in audit.crossing), audit.findings
+    assert audit.dcn_bytes < grad_elems * 4  # the flat f32 ring's payload
+
+
+# -- slow-slice degradation ----------------------------------------------
+
+
+def test_slice_degrade_controller_drill(tmp_path, hybrid_mesh):
+    from pytorch_distributedtraining_tpu.runtime.membership import (
+        MembershipStore,
+    )
+
+    t = [0.0]
+    store = MembershipStore(str(tmp_path / "members"), clock=lambda: t[0])
+    ctl = SliceDegradeController(
+        2,
+        store=store,
+        hosts_by_slice={0: ["host-a"], 1: ["host-b"]},
+        threshold_frac=0.5,
+        clock=lambda: t[0],
+    )
+    # healthy samples: best-seen bandwidth latches, nothing arms
+    assert ctl.note_axis_bandwidth(100.0) is False
+    assert ctl.decide() is None
+    t[0] = 1.0
+    # bandwidth collapses under 0.5 x best -> armed, but the axis-level
+    # signal alone cannot name a slice
+    assert ctl.note_axis_bandwidth(10.0) is True
+    assert ctl.decide() is None
+    t[0] = 1.5
+    # the straggler monitor localizes blame: rank 5 lives in slice 1
+    ctl.note_straggler(rank=5, ranks_per_slice=4)
+    t[0] = 2.0
+    decision = ctl.decide()
+    assert decision is not None
+    assert decision.excluded_slice == 1
+    assert decision.surviving_slices == (0,)
+    assert "comm-bandwidth-degraded" in decision.reason
+    # first degraded signal was t=1.0, decision at t=2.0
+    assert decision.time_to_degrade_s == pytest.approx(1.0)
+    assert decision.quarantined_hosts == ("host-b",)
+    assert store.is_quarantined("host-b")
+    assert not store.is_quarantined("host-a")
+    # the verdict is sticky (one mesh surgery per incident)
+    assert ctl.decide() is decision
+
+    # mesh surgery: 2 slices -> 1 survivor loses the slice boundary, so
+    # the flat sync is the documented degenerate form
+    survivor = exclude_slice(hybrid_mesh, decision.excluded_slice)
+    assert int(np.asarray(survivor.devices).size) == 4
+    kept = set(d.id for d in np.asarray(survivor.devices).ravel())
+    dp_idx = hybrid_mesh.axis_names.index("dp")
+    slice0 = set(
+        d.id
+        for d in np.take(
+            np.asarray(hybrid_mesh.devices), 0, axis=dp_idx
+        ).ravel()
+    )
+    assert kept == slice0
+    assert slice_axis(survivor) is None
+    init_fn, loss_fn, _ = _mlp_problem()
+    with pytest.raises(ValueError, match="slice"):
+        HierGradStep(loss_fn, optim.adamw(lr=1e-2), survivor, DDP())
+
+
+def test_exclude_slice_keeps_hierarchy_with_survivors(devices8):
+    # 4 slices x 2-wide ICI: dropping one leaves a REAL hierarchy (3
+    # slices), so the re-formed mesh keeps its slice-axis registration
+    mesh = make_hybrid_mesh(MeshSpec(fsdp=2), dcn_dp=4, devices=devices8)
+    survivor = exclude_slice(mesh, 2)
+    assert survivor.shape["dp"] == 3 and survivor.shape["fsdp"] == 2
+    assert slice_axis(survivor) == "dp"
+    dp_idx = mesh.axis_names.index("dp")
+    dropped = set(
+        d.id for d in np.take(np.asarray(mesh.devices), 2, axis=dp_idx).ravel()
+    )
+    kept = set(d.id for d in np.asarray(survivor.devices).ravel())
+    assert not (kept & dropped)
+    with pytest.raises(ValueError):
+        exclude_slice(mesh, 7)
+
+
+# -- planner: hier candidates on measured bandwidths ---------------------
+
+
+def test_planner_ranks_hier_by_measured_bandwidth():
+    from pytorch_distributedtraining_tpu.analyze.plan import Plan
+    from pytorch_distributedtraining_tpu.analyze.planner import predict
+
+    def twin(hier):
+        return Plan(
+            model="mlp", topology="2x4", dp=2, fsdp=4,
+            policy="zero2", hier=hier,
+        )
+
+    # measured: DCN an order slower than ICI -> two-level wins its twin
+    measured = {"dp": 2.0e9, "fsdp": 1.6e10}
+    p_hier, p_flat = twin(True), twin(False)
+    predict(p_hier, axis_bw=measured)
+    predict(p_flat, axis_bw=measured)
+    assert p_hier.predicted["comm_s"] < p_flat.predicted["comm_s"]
+    assert p_hier.predicted["dcn_bytes"] < p_flat.predicted["dcn_bytes"]
+    # uniform (analytic scalar) bandwidth: the hierarchy's extra ICI
+    # traffic buys nothing -> flat wins, hier is not a free default
+    p_hier2, p_flat2 = twin(True), twin(False)
+    predict(p_hier2, axis_bw=1.8e10)
+    predict(p_flat2, axis_bw=1.8e10)
+    assert p_flat2.predicted["comm_s"] <= p_hier2.predicted["comm_s"]
+
+
+def test_planner_search_records_bandwidth_source():
+    from pytorch_distributedtraining_tpu.analyze.planner import search
+
+    doc = search(
+        "mlp", "2x4", probe=False, top_k=128,
+        axis_bw={"dp": 2.0e9, "fsdp": 1.6e10},
+        axis_bw_source="measured:calibration.json",
+    )
+    assert doc["meta"]["axis_bw_source"] == "measured:calibration.json"
+    keys = {(p["dp"], p["fsdp"], p["policy"], p["hier"])
+            for p in doc["ranked"]}
+    assert any(k[3] for k in keys), "no hier candidate survived ranking"
+    # under a measured slow DCN the BEST pipeline-free plan (its sync
+    # ring spans both slices, so the layout choice is all about the
+    # crossing) is the two-level form — the flat ring of the same width
+    # drags its full payload across the boundary at the 2 GB/s hop
+    syncing = [
+        p for p in doc["ranked"]
+        if p["pp"] == 1 and p["dp"] * p["fsdp"] > 1
+    ]
+    assert syncing and syncing[0]["hier"] is True
+    flat_twin = next(p for p in syncing if not p["hier"])
+    assert syncing[0]["predicted"]["dcn_bytes"] < (
+        flat_twin["predicted"]["dcn_bytes"]
+    )
+    # with no axis_bw the meta says so
+    doc2 = search("mlp", "2x4", probe=False)
+    assert doc2["meta"]["axis_bw_source"] == "analytic"
+
+
+# -- GRAFT_PLAN round-trip ------------------------------------------------
+
+
+def test_plan_apply_carries_hier_into_tpu_config():
+    from pytorch_distributedtraining_tpu.analyze.plan import (
+        Plan,
+        apply_plan_to_config,
+    )
+    from pytorch_distributedtraining_tpu.stoke.config import TPUConfig
+
+    plan = Plan(dp=2, fsdp=4, policy="zero2", hier=True)
+    cfg, conflicts = apply_plan_to_config(plan, TPUConfig(), env={})
+    assert cfg.hier is True and cfg.dp == 2 and cfg.fsdp == 4
+    assert not conflicts
+    # the env twin is explicit and wins; the disagreement is surfaced
+    cfg2, conflicts2 = apply_plan_to_config(
+        plan, TPUConfig(), env={"GRAFT_HIER": "0"}
+    )
+    assert cfg2.hier is False
+    assert any(c["knob"] == "hier" for c in conflicts2)
+
+
+def test_facade_hier_builds_hybrid_mesh_and_two_level_step():
+    from pytorch_distributedtraining_tpu.stoke.config import TPUConfig
+    from tests.test_stoke_facade import _batch, _stoke
+
+    x, y = _batch()
+    s_hier = _stoke(
+        configs=[TPUConfig(dp=2, fsdp=4, hier=True)], grad_accum_steps=1,
+    )
+    assert s_hier.hier and slice_axis(s_hier.mesh) == "dp"
+    m = s_hier.fused_step(x, y)
+    assert isinstance(s_hier._fused, HierGradStep)
+    s_flat = _stoke(
+        configs=[TPUConfig(dp=2, fsdp=4)], grad_accum_steps=1,
+    )
+    m_flat = s_flat.fused_step(x, y)
+    assert isinstance(s_flat._fused, TrainStep)
+    # same data, same init: the two-level sync changes bytes, not math
+    assert float(m["loss"]) == pytest.approx(float(m_flat["loss"]), rel=1e-6)
+
+
+def test_facade_hier_fallbacks_warn():
+    from pytorch_distributedtraining_tpu.stoke.config import TPUConfig
+    from tests.test_stoke_facade import _batch, _stoke
+
+    # grad accumulation windows don't compose with the fused two-level
+    # step: the facade says so and runs the flat sync
+    s = _stoke(
+        configs=[TPUConfig(dp=2, fsdp=4, hier=True)], grad_accum_steps=2,
+    )
+    x, y = _batch()
+    with pytest.warns(UserWarning, match="flat"):
+        s.fused_step(x, y)
+    assert isinstance(s._fused, TrainStep)
+    # dp=1 has no slice boundary: hier is refused at mesh-build time
+    with pytest.warns(UserWarning, match="dp < 2"):
+        s2 = _stoke(
+            configs=[TPUConfig(fsdp=8, hier=True)], grad_accum_steps=1,
+        )
+    assert not s2.hier
+
+
+def test_fairscale_driver_plan_hier_round_trip(capsys, monkeypatch):
+    """$GRAFT_PLAN's hier lands in drivers/fairscale_ddp.py: the driver
+    re-forms its mesh as 2 slices and swaps in the two-level step."""
+    from drivers import fairscale_ddp
+
+    monkeypatch.setenv("GRAFT_PLAN", json.dumps(
+        {"model": "espcn", "dp": 2, "fsdp": 4, "policy": "zero2",
+         "hier": True}
+    ))
+    monkeypatch.delenv("GRAFT_HIER", raising=False)
+    loss = fairscale_ddp.main(
+        ["--synthetic", "--synthetic-n", "48", "--epochs", "1",
+         "--batch-size", "16", "--workers", "0"]
+    )
+    out = capsys.readouterr().out
+    assert "Hierarchical sync: 2 slices" in out
+    assert "Two-level sync:" in out
+    assert "plan conflict" not in out
+    assert loss is not None and np.isfinite(loss)
+
+
+def test_stoke_driver_plan_hier_round_trip(tmp_path, capsys, monkeypatch):
+    """$GRAFT_PLAN's hier round-trips through drivers/stoke_ddp.py via
+    the facade apply path: the applied plan lands in
+    analyze.plan.runtime_stats with hier intact and no conflict."""
+    from pytorch_distributedtraining_tpu.analyze import plan as plan_mod
+    from drivers import stoke_ddp
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("WANDB_MODE", "disabled")
+    monkeypatch.setenv("GRAFT_PLAN", json.dumps(
+        {"model": "swinir", "dp": 2, "fsdp": 4, "policy": "zero2",
+         "hier": True}
+    ))
+    monkeypatch.delenv("GRAFT_HIER", raising=False)
+    real_swinir = stoke_ddp.SwinIR
+
+    def tiny_swinir(**kw):
+        kw.update(depths=[2], embed_dim=12, num_heads=[2])
+        return real_swinir(**kw)
+
+    monkeypatch.setattr(stoke_ddp, "SwinIR", tiny_swinir)
+    plan_mod.reset()
+    try:
+        train_loss, val_loss = stoke_ddp.main(
+            ["--synthetic", "--synthetic-n", "64", "--nEpochs", "1",
+             "--batchSize", "4", "--threads", "0",
+             "--projectName", "test-hier"]
+        )
+        active = plan_mod.runtime_stats["active_plan"]
+        assert active is not None and active["hier"] is True
+        assert not any(
+            c["knob"] == "hier"
+            for c in plan_mod.runtime_stats["conflicts"]
+        )
+        assert np.isfinite(train_loss) and np.isfinite(val_loss)
+    finally:
+        plan_mod.reset()

@@ -75,9 +75,10 @@ class TestEnumeration:
         cands = enumerate_candidates(
             "mlp", "1x2", wires=(None,), remats=("none",),
         )
-        # 3 factorizations x 4 policies; pp=1 meshes carry 1 pipeline
-        # combo, the pp=2 mesh carries len(schedules) x len(micro) = 4
-        assert len(cands) == 2 * 4 * 1 + 1 * 4 * 4
+        # 3 factorizations x 4 policies x 2 hier spellings; pp=1 meshes
+        # carry 1 pipeline combo, the pp=2 mesh carries
+        # len(schedules) x len(micro) = 4
+        assert len(cands) == (2 * 4 * 1 + 1 * 4 * 4) * 2
         keys = [p.key() for p in cands]
         assert len(keys) == len(set(keys)), "candidates must be unique"
         # nothing silently dropped: every candidate is either alive or
