@@ -2179,6 +2179,40 @@ def _bench() -> None:
             raise
         except Exception as e:  # noqa: BLE001 — analyzer crash != finding
             print(f"# child: graftcheck unavailable: {e}", flush=True)
+    # graftcheck source plane (untimed, no XLA work): the whole-repo AST
+    # lint — host-divergent collectives, knob-registry drift, fault-site
+    # drift, stdlib-only contracts. Same publication contract as the
+    # artifact planes: ERROR findings exit 7 (a benched binary whose
+    # source carries a pod-deadlock hazard or a drifted knob table is
+    # not a publishable configuration), same GRAFT_BENCH_ANALYZE opt-out,
+    # and analyzer crashes degrade to source_findings=None.
+    source_findings = None
+    if os.environ.get("GRAFT_BENCH_ANALYZE", "1").strip().lower() not in (
+        "0", "false", "off", "no"
+    ):
+        try:
+            from pytorch_distributedtraining_tpu.analyze.source_rules import (
+                source_report,
+            )
+
+            src_report = source_report()
+            for line in src_report.render().splitlines():
+                print(f"# child: source: {line}", flush=True)
+            source_findings = src_report.counts()
+            if not src_report.ok:
+                print(
+                    "SOURCE ANALYSIS ERRORS: "
+                    + "; ".join(
+                        f"{f.rule}: {f.message}" for f in src_report.errors
+                    )[:400]
+                    + " — refusing to publish",
+                    flush=True,
+                )
+                sys.exit(7)
+        except SystemExit:
+            raise
+        except Exception as e:  # noqa: BLE001 — analyzer crash != finding
+            print(f"# child: source plane unavailable: {e}", flush=True)
     # Convergence A/B gate (untimed; runs AFTER graftcheck so its extra
     # compiles land outside the recompile-drift window): a short fp32
     # TrainStep run vs the quantized step, both from identical init
@@ -2516,6 +2550,7 @@ def _bench() -> None:
                 ),
                 "compile_cache": compile_cache,
                 "static_findings": static_findings,
+                "source_findings": source_findings,
                 "peak_hbm_bytes": peak_hbm_bytes,
                 "remat": remat_impl,
                 "scan_layers": scan_layers,

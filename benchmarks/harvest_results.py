@@ -25,6 +25,10 @@ STAGES = [
     ("bench", "headline SwinIR-S x2 train step (bench.py, committed knobs)"),
     ("analyze", "graftcheck static analysis of the flagship step "
                 "(python -m pytorch_distributedtraining_tpu.analyze)"),
+    ("source", "graftcheck source plane: whole-repo SPMD-hazard AST lint "
+               "+ GRAFT_* knob-registry drift "
+               "(python -m pytorch_distributedtraining_tpu.analyze "
+               "--source)"),
     ("telemetry", "goodput/MFU breakdown (bench.py telemetry ledger + "
                   "trace_summary.py span rollup)"),
     ("compile", "cold vs cached vs scanned compile time (compile_bench.py)"),

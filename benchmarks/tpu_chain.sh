@@ -88,6 +88,10 @@ full_chain() {
   # bench.py's own wait-then-retry (round-5 envelope) rides mid-stage
   # pool flaps instead of dying to the outer timeout (review finding r5)
   run bench 1300 env GRAFT_BENCH_TOTAL=1200 python bench.py
+  # source plane: whole-repo AST lint (no accelerator needed — run it
+  # while the pool is warm anyway so the harvest shows the verdict next
+  # to the numbers it gates)
+  run source 240 python -m pytorch_distributedtraining_tpu.analyze --source
   # dispatch-cost decomposition for the scan anomaly (VERDICT #4) —
   # before facade because it is 3x cheaper and a short window (17 min
   # observed) should still capture it

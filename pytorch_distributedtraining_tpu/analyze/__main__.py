@@ -13,6 +13,9 @@ layout can be vetted on a laptop before burning a pod slot::
     python -m pytorch_distributedtraining_tpu.analyze \
         --fixture donation-conflict    # seeded-violation self-demo
 
+    python -m pytorch_distributedtraining_tpu.analyze --source
+        # whole-repo source plane: SPMD-hazard AST lint + knob registry
+
 Exit codes: 0 clean (warn/info allowed), 1 error-severity findings,
 2 usage/environment problems.
 """
@@ -84,6 +87,18 @@ def build_parser() -> argparse.ArgumentParser:
         "--fixture", default=None,
         help="analyze a named seeded-violation fixture instead of a "
         "model (see --list-fixtures)",
+    )
+    p.add_argument(
+        "--source", action="store_true",
+        help="run the source plane over the whole repo (AST lint: "
+        "host-divergence, knob registry, fault-site drift, contracts) "
+        "instead of analyzing a step; with --fixture, run a src-* "
+        "seeded snippet",
+    )
+    p.add_argument(
+        "--write-knobs", action="store_true",
+        help="with --source: regenerate docs/KNOBS.md from the knob "
+        "registry before reporting",
     )
     p.add_argument(
         "--ignore", default=None,
@@ -269,6 +284,59 @@ def _build_pipeline_step(args, mesh_kw):
     return step, state, batch
 
 
+def _main_source(args, ignore) -> int:
+    """The --source path: whole-repo AST lint, no step, no mesh.
+
+    Exit codes match the step path: 0 clean, 1 error findings, 2 on a
+    fixture expectation miss or usage problem.
+    """
+    from .source_rules import source_report
+
+    if args.write_knobs:
+        from .knobs import write_knobs_md
+
+        print(f"wrote {write_knobs_md()}")
+
+    if args.fixture:
+        from .fixtures import build_source_fixture
+
+        try:
+            facts, extras, expected = build_source_fixture(args.fixture)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        report = source_report(facts=facts, extras=extras, ignore=ignore)
+        print(f"analyzing source fixture {args.fixture!r}")
+        print(report.render())
+        if expected is not None:
+            rule_name, sev = expected
+            hit = [
+                f for f in report.by_rule(rule_name) if f.severity is sev
+            ]
+            print(
+                f"fixture expectation [{sev}] {rule_name}: "
+                + ("hit" if hit else "MISSED")
+            )
+            if not hit:
+                return 2
+        return report.exit_code
+
+    report = source_report(ignore=ignore)
+    print("analyzing repo source (plane: source)")
+    print(report.render())
+    # one JSON summary line: benchmarks/harvest_results.py renders stage
+    # output from JSON lines only — this is what the `source` stage shows
+    import json
+
+    print(json.dumps({
+        "stage": "source",
+        "rules": len(report.rules_run),
+        "ok": report.ok,
+        **report.counts(),
+    }))
+    return report.exit_code
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -279,11 +347,24 @@ def main(argv=None) -> int:
             print(f"{name:24s} [{plane:7s}] {doc}")
         return 0
     if args.list_fixtures:
-        from .fixtures import FIXTURES
+        from .fixtures import FIXTURES, SOURCE_FIXTURES
 
-        for name in sorted(FIXTURES):
+        for name in sorted(FIXTURES) + sorted(SOURCE_FIXTURES):
             print(name)
         return 0
+
+    ignore_cli = (
+        frozenset(
+            p.strip() for p in args.ignore.split(",") if p.strip()
+        )
+        if args.ignore is not None
+        else None
+    )
+
+    # src-* fixtures are source-plane snippets; --fixture src-… implies
+    # --source so the two fixture families share one flag
+    if args.source or (args.fixture or "").startswith("src-"):
+        return _main_source(args, ignore_cli)
 
     mesh_kw = _parse_mesh(args.mesh, args.pp)
     n_devices = 1
@@ -305,13 +386,7 @@ def main(argv=None) -> int:
         )
         return 2
 
-    ignore = (
-        frozenset(
-            p.strip() for p in args.ignore.split(",") if p.strip()
-        )
-        if args.ignore is not None
-        else None
-    )
+    ignore = ignore_cli
 
     from .runner import analyze_step
 

@@ -315,3 +315,160 @@ def build_fixture(name: str, devices=None):
             f"unknown fixture {name!r}; have {sorted(FIXTURES)}"
         ) from None
     return builder(devices)
+
+
+# -- source-plane fixtures ----------------------------------------------------
+#
+# The step fixtures above seed violations into *compiled artifacts*;
+# these seed them into *source code* — each is a snippet (plus rule
+# inputs via extras) that trips exactly one source rule when run through
+# ``source_rules.source_report(facts=..., extras=...)``. The snippets
+# are string literals, so scanning THIS file never trips the linter on
+# its own fixtures. Builders return ``(facts, extras, expected)``.
+#
+# ``_LIB`` paths the snippets into library scope: the hygiene rules
+# (blocking-host-sync, import-time-env-read) deliberately skip
+# script-style files, and a fixture must land inside the enforced zone.
+
+_LIB = "pytorch_distributedtraining_tpu/_source_fixture_.py"
+
+_SRC_HOST_DIVERGENT = '''\
+from .runtime.dist import coordination_barrier, rank
+
+def grad_epilogue(state):
+    if rank() == 0:
+        # only rank 0 arrives: everyone inside blocks forever
+        coordination_barrier("epilogue", timeout_s=30.0)
+    return state
+'''
+
+_SRC_BLOCKING_SYNC = '''\
+import time
+
+def tick_loop(step, batches):
+    total = 0.0
+    for b in batches:
+        t0 = time.perf_counter()
+        loss = step(b)
+        total += loss.item()  # per-iteration host sync, unguarded
+    return total
+'''
+
+_SRC_STDLIB_IMPORT = '''\
+import jax
+
+def world():
+    return jax.device_count()
+'''
+
+_SRC_FAULT_DRIFT = '''\
+from .resilience.faults import fault_point
+
+def admit(req):
+    fault_point("serve.admit", rid=req)
+'''
+
+_SRC_IMPORT_ENV = '''\
+import os
+
+_DEBUG = os.environ.get("GRAFT_FIXTURE_DEBUG", "0")
+
+def debug():
+    return _DEBUG
+'''
+
+_SRC_KNOB_READ = '''\
+import os
+
+def knob():
+    return os.environ.get("GRAFT_FIXTURE_KNOB", "1")
+'''
+
+_SRC_EMPTY = '''\
+def noop():
+    return None
+'''
+
+# four ranks; op #2's replica groups cover only ranks 0 and 2 — ranks 1
+# and 3 compiled a program that issues one less collective
+_SRC_DIVERGENT_HLO = """\
+HloModule divergent_fixture
+
+ENTRY %main (p0: f32[128]) -> f32[128] {
+  %p0 = f32[128]{0} parameter(0)
+  %ar0 = f32[128]{0} all-reduce(f32[128]{0} %p0), replica_groups={{0,1},{2,3}}, to_apply=%sum
+  ROOT %ar1 = f32[128]{0} all-reduce(f32[128]{0} %ar0), replica_groups={{0,2}}, to_apply=%sum
+}
+"""
+
+
+def _snippet_fixture(code, extras, expected, path=_LIB):
+    from .astlint import collect_snippet
+
+    def build():
+        return collect_snippet(code, path=path), dict(extras), expected
+
+    return build
+
+
+SOURCE_FIXTURES = {
+    "src-clean": _snippet_fixture(_SRC_EMPTY, {}, None),
+    "src-host-divergent": _snippet_fixture(
+        _SRC_HOST_DIVERGENT, {},
+        ("host-divergent-collective", Severity.ERROR),
+    ),
+    "src-blocking-sync": _snippet_fixture(
+        _SRC_BLOCKING_SYNC, {},
+        ("blocking-host-sync", Severity.WARN),
+    ),
+    "src-stdlib-import": _snippet_fixture(
+        _SRC_STDLIB_IMPORT, {"stdlib_only_modules": (_LIB,)},
+        ("stdlib-only-violation", Severity.ERROR),
+    ),
+    # the consumed site is registered; the doc table carries one stale
+    # row — exactly the documented-but-unregistered drift direction
+    "src-fault-drift": _snippet_fixture(
+        _SRC_FAULT_DRIFT,
+        {
+            "fault_registry": ("serve.admit",),
+            "fault_docs": ("serve.admit", "stale.site"),
+        },
+        ("fault-site-drift", Severity.ERROR),
+    ),
+    "src-import-env": _snippet_fixture(
+        _SRC_IMPORT_ENV, {},
+        ("import-time-env-read", Severity.WARN),
+    ),
+    "src-knob-undocumented": _snippet_fixture(
+        _SRC_KNOB_READ, {"knobs_md": {}},
+        ("knob-undocumented", Severity.ERROR),
+    ),
+    "src-knob-dead": _snippet_fixture(
+        _SRC_EMPTY,
+        {"knobs_md": {"GRAFT_GONE": "| `GRAFT_GONE` | … |"}},
+        ("knob-dead", Severity.WARN),
+    ),
+    "src-twin-mismatch": _snippet_fixture(
+        _SRC_EMPTY, {"config_twins": {"GRAFT_PHANTOM": "phantom"}},
+        ("knob-twin-mismatch", Severity.ERROR),
+    ),
+    "src-lockstep-divergent": _snippet_fixture(
+        _SRC_EMPTY,
+        {
+            "lockstep_programs": [("divergent_fixture", _SRC_DIVERGENT_HLO)],
+            "lockstep_ranks": 4,
+        },
+        ("collective-lockstep", Severity.ERROR),
+    ),
+}
+
+
+def build_source_fixture(name: str):
+    """(facts, extras, expected) for a source-plane fixture."""
+    try:
+        builder = SOURCE_FIXTURES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown source fixture {name!r}; have {sorted(SOURCE_FIXTURES)}"
+        ) from None
+    return builder()

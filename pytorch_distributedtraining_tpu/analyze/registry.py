@@ -10,6 +10,10 @@ registered under a unique name on one inspection plane:
   transfers).
 - ``runtime``: reads measured facts (compile-cache entry counts) the
   bench harness records around its timed windows.
+- ``source``: reads ``ctx.source`` (the whole repo's AST facts from
+  :mod:`.astlint`) — catches host-side SPMD hazards no artifact plane
+  can see: rank-divergent control flow gating a collective, import-time
+  env reads, contract-breaking imports, drifted registries.
 
 Rules self-check their prerequisites and return ``[]`` when the artifact
 or config they inspect is absent — ``run_rules`` never needs a skip
@@ -23,7 +27,7 @@ from dataclasses import dataclass, field
 
 from .findings import Finding, Report, ignored_rules
 
-PLANES = ("trace", "hlo", "runtime")
+PLANES = ("trace", "hlo", "runtime", "source")
 
 
 @dataclass
@@ -51,6 +55,7 @@ class AnalysisContext:
     cache_entries_before: object = None  # int | None
     cache_entries_after: object = None   # int | None
     cache_window: str = ""        # label for the fixed-shape window
+    source: object = None         # astlint.SourceFacts (whole-repo AST facts)
     extras: dict = field(default_factory=dict)
 
 

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 # importing the rule modules populates the registry
 from . import hlo_rules as _hlo_rules  # noqa: F401
 from . import runtime_rules as _runtime_rules  # noqa: F401
+from . import source_rules as _source_rules  # noqa: F401
 from . import trace_rules as _trace_rules  # noqa: F401
 from .findings import Report
 from .registry import PLANES, RULES, AnalysisContext, run_rules

@@ -744,7 +744,9 @@ def main(argv=None) -> int:
         ctl.epoch = ctl.store.bump_epoch(
             world=world, mode="start", reason="launch"
         )
-        ctl.store.publish_generation(
+        # single-publisher protocol: exactly one controller publishes,
+        # every follower adopts — the asymmetry IS the design
+        ctl.store.publish_generation(  # graftcheck: ok(host-divergent-collective)
             epoch=ctl.epoch, world=world, assignments=assignments,
             port=port, mode=None, attempt=0,
         )
@@ -757,7 +759,8 @@ def main(argv=None) -> int:
     def _publish_terminal(terminal_mode: str, code: int) -> None:
         if ctl is not None and ctl.controller:
             try:
-                ctl.store.publish_generation(
+                # single-publisher terminal marker (see generation 0 above)
+                ctl.store.publish_generation(  # graftcheck: ok(host-divergent-collective)
                     epoch=ctl.epoch + 1, world=0, assignments=[],
                     port=None, mode=terminal_mode, attempt=gen, code=code,
                 )
@@ -835,7 +838,9 @@ def main(argv=None) -> int:
 
         # -- follower: the controller decides; adopt its next generation --
         if ctl is not None and not ctl.controller:
-            doc = ctl.store.wait_generation(
+            # follower-only wait: the controller never waits on itself —
+            # it is the one publishing the generation being waited for
+            doc = ctl.store.wait_generation(  # graftcheck: ok(host-divergent-collective)
                 min_epoch=ctl.epoch + 1, timeout_s=gen_timeout_s,
                 heartbeat_host=host_id,
             )

@@ -95,8 +95,11 @@ def reset_runtime_stats() -> None:
 _HOST_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
 # default liveness window: a host whose heartbeat is older than this is
-# not counted as capacity (the launcher heartbeats ~1/s from its monitor)
-DEFAULT_TTL_S = float(os.environ.get("GRAFT_MEMBERSHIP_TTL_S", "30"))
+# not counted as capacity (the launcher heartbeats ~1/s from its monitor).
+# GRAFT_MEMBERSHIP_TTL_S is resolved at store construction, not here: an
+# import-time read would freeze whatever the first importer's environment
+# held (graftcheck source rule `import-time-env-read`).
+DEFAULT_TTL_S = 30.0
 
 
 def _tracer():

@@ -1,15 +1,22 @@
-"""Importing the package must never initialize a jax backend.
+"""Import hygiene, two layers: static contract + runtime backend probe.
 
-Regression guard for the class of bug found in round 4: ``FeatLoss``
-construction ran ``jax.random`` ops, so ``from ...losses import
-feat_loss`` (the first line of a driver) initialized the backend — which
-HANGS on machines whose configured accelerator is unreachable, breaking
-even ``--help``. Every module, every public drag-in symbol (`__getattr__`
-lazies included), and both driver modules must import with zero backends
-live.
+The *static* layer is graftcheck's ``stdlib-only-violation`` source rule
+(`analyze/source_rules.py`): modules contracted as stdlib-only —
+membership, fleet, opcost, slo, router, plan, … — must not import
+jax/flax at module level. The hand-rolled per-module walker this file
+once needed is gone; the tests here assert the rule fires on a seeded
+fixture and is clean on the real contracted modules, so the contract
+lives in ONE place (``STDLIB_ONLY_MODULES``) with a named, ignorable
+rule instead of a bespoke test.
 
-Runs in a subprocess because this process's conftest already initialized
-the CPU backend.
+The *runtime* layer stays: regression guard for the class of bug found
+in round 4, where ``FeatLoss`` construction ran ``jax.random`` ops, so
+``from ...losses import feat_loss`` (the first line of a driver)
+initialized the backend — which HANGS on machines whose configured
+accelerator is unreachable, breaking even ``--help``. No static rule
+can see that (the import is lazy and legal); only importing everything
+and checking zero backends are live can. Runs in a subprocess because
+this process's conftest already initialized the CPU backend.
 """
 
 import os
@@ -17,6 +24,7 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 _PROBE = r"""
 import os, pkgutil, sys
@@ -67,3 +75,38 @@ def test_no_backend_init_at_import():
         f"stdout: {proc.stdout[-2000:]}\nstderr: {proc.stderr[-2000:]}"
     )
     assert "IMPORT-HYGIENE-OK" in proc.stdout
+
+
+# -- static layer: the stdlib-only contract as a graftcheck rule -------------
+
+
+def test_stdlib_only_rule_clean_on_real_modules():
+    from pytorch_distributedtraining_tpu.analyze.source_rules import (
+        STDLIB_ONLY_MODULES,
+        source_report,
+    )
+
+    report = source_report(REPO)
+    assert not report.by_rule("stdlib-only-violation"), report.render()
+    # the contract list itself must not rot: every entry is a real file
+    for path in STDLIB_ONLY_MODULES:
+        assert os.path.exists(os.path.join(REPO, path)), (
+            f"STDLIB_ONLY_MODULES names a missing file: {path}"
+        )
+
+
+def test_stdlib_only_rule_fires_on_seeded_fixture():
+    from pytorch_distributedtraining_tpu.analyze import Severity
+    from pytorch_distributedtraining_tpu.analyze.fixtures import (
+        build_source_fixture,
+    )
+    from pytorch_distributedtraining_tpu.analyze.source_rules import (
+        source_report,
+    )
+
+    facts, extras, expected = build_source_fixture("src-stdlib-import")
+    assert expected == ("stdlib-only-violation", Severity.ERROR)
+    report = source_report(facts=facts, extras=extras)
+    (hit,) = report.by_rule("stdlib-only-violation")
+    assert hit.severity is Severity.ERROR
+    assert "jax" in hit.message
